@@ -1,0 +1,30 @@
+"""ddl_tpu — TPU-native distributed data loading framework.
+
+A ground-up JAX/XLA re-design of the capabilities of ``maximilian-tech/ddl``
+(an MPI-based distributed dataloader for PyTorch): dedicated producer workers
+ingest/preprocess/shuffle data into shared-memory window rings; trainer
+processes drain windows zero-copy and stream them into TPU HBM with
+double-buffered device ingest; global shuffle rides XLA collectives over
+ICI/DCN instead of MPI ``Sendrecv_replace``.
+
+Public API preserves the reference's 5-symbol surface
+(reference ``ddl/__init__.py:7-21``): ``ProducerFunctionSkeleton``,
+``DataProducerOnInitReturn``, ``distributed_dataloader``,
+``DistributedDataLoader``, ``Marker``.
+"""
+
+from ddl_tpu.datasetwrapper import (
+    DataProducerOnInitReturn,
+    ProducerFunctionSkeleton,
+)
+from ddl_tpu.types import Marker, RunMode, Topology
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataProducerOnInitReturn",
+    "Marker",
+    "ProducerFunctionSkeleton",
+    "RunMode",
+    "Topology",
+]

@@ -1,0 +1,73 @@
+"""User extension surface: the producer-function skeleton.
+
+API-compatible with reference ``ddl/datasetwrapper.py:4-19`` and
+``ddl/datapusher.py:14-19``: users subclass :class:`ProducerFunctionSkeleton`,
+override ``on_init`` (load the dataset, report geometry), ``post_init``
+(write the first window) and ``execute_function`` (refill / in-place shuffle
+each iteration).  Instances are constructed on the consumer and shipped to
+producer workers by pickle (reference ``ddl/mpi_dataloader.py:130-136``),
+so subclasses must be picklable.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataProducerOnInitReturn:
+    """Geometry a producer function reports from ``on_init``.
+
+    Parity: reference ``ddl/datapusher.py:14-19``.
+
+    Attributes:
+      nData:   number of samples in one window (rows).
+      nValues: flattened feature width per sample (columns).
+      shape:   full window shape, normally ``(nData, nValues)``.
+      splits:  column widths to re-split a batch into the user's tensor
+               tuple, e.g. ``(3, 1, 1)`` for (x, y, weight)
+               (reference ``tests/run_ddl.py:156-159``).
+      dtype:   window element dtype.  The reference hardwired float32
+               (``ddl/connection.py:105-106``, SURVEY Q5); here any numpy
+               dtype is honoured end-to-end.
+    """
+
+    nData: int
+    nValues: int
+    shape: tuple[int, ...]
+    splits: tuple[int, ...]
+    dtype: Any = np.float32
+
+
+class ProducerFunctionSkeleton(abc.ABC):
+    """Abstract producer function (reference ``ddl/datasetwrapper.py:4``).
+
+    Lifecycle inside a producer worker:
+
+    1. ``on_init(producer_idx=..., n_producers=..., instance_idx=...,
+       n_instances=...)`` → :class:`DataProducerOnInitReturn`.  Load/open the
+       dataset shard for this worker here (lazily — this runs in the worker,
+       not on the consumer).
+    2. ``post_init(my_ary=...)`` → write the initial window contents into
+       the provided array view (reference ``tests/run_ddl.py:152-161``).
+    3. ``execute_function(my_ary=..., epoch=...)`` → called once per window
+       refill; typically an in-place shuffle or the next chunk of a stream
+       (reference ``tests/run_ddl.py:163-167``).
+
+    All hooks accept ``**kwargs`` so the framework can grow the context it
+    passes without breaking user subclasses.
+    """
+
+    @abc.abstractmethod
+    def on_init(self, **kwargs: Any) -> DataProducerOnInitReturn:
+        raise NotImplementedError
+
+    def post_init(self, **kwargs: Any) -> None:
+        """Fill the first window. Default: no-op (stream-style producers)."""
+
+    def execute_function(self, **kwargs: Any) -> None:
+        """Refill/refresh the window before each handoff. Default: no-op."""

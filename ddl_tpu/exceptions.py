@@ -1,0 +1,54 @@
+"""Exceptions for ddl_tpu.
+
+Parity: the reference exposes a single ``DoesNotMatchError``
+(reference ``ddl/exceptions.py:1``) whose constructor is broken (``__init``
+typo, SURVEY Q3).  Here the hierarchy is real and the constructors work.
+"""
+
+from __future__ import annotations
+
+
+class DDLError(Exception):
+    """Base class for all ddl_tpu errors."""
+
+
+class DoesNotMatchError(DDLError):
+    """Topology or shape mismatch (reference ``ddl/exceptions.py:1``).
+
+    Raised when the requested loader/trainer topology cannot be realised,
+    e.g. a producer block that would span shared-memory domains
+    (reference ``ddl/ddl_env.py:72-73``).
+    """
+
+    def __init__(self, value: object = None, message: str = ""):
+        self.value = value
+        self.message = message
+        super().__init__(value, message)
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        if self.message:
+            return f"{self.value!r}: {self.message}"
+        return repr(self.value)
+
+
+class TransportError(DDLError):
+    """A transport-level failure (ring corrupt, peer vanished, bad slot)."""
+
+
+class ShutdownRequested(DDLError):
+    """Internal control-flow signal: the pipeline is shutting down.
+
+    The TPU-native replacement for the reference's ``WorkerInfo.STOP``
+    sentinel (reference ``ddl/connection.py:12-16``): waits that observe a
+    shutdown flag raise this instead of returning a status enum.
+    """
+
+
+class StallTimeoutError(TransportError):
+    """A blocking wait on the ring exceeded its deadline.
+
+    The reference had no deadline at all — a lost peer deadlocked the job
+    until the pytest 100 s timeout killed it (reference
+    ``tests/test_ddl.py:8``).  Here every wait carries a configurable
+    timeout so failure detection is built in.
+    """

@@ -1,0 +1,118 @@
+"""Observability: counters, gauges and stall accounting.
+
+The reference had no metrics at all (SURVEY §5.5) — only DEBUG log lines.
+The rebuild's north-star metrics (samples/sec/host ingest, input-pipeline
+stall %, H2D bandwidth utilisation — BASELINE.md) need first-class
+instrumentation, so every pipeline component records into a shared
+:class:`Metrics` registry that the benchmark suite and user code can read.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Dict
+
+
+@dataclasses.dataclass
+class Timer:
+    """Accumulates total seconds and call count for one labelled section."""
+
+    total_s: float = 0.0
+    count: int = 0
+
+    def add(self, dt: float) -> None:
+        self.total_s += dt
+        self.count += 1
+
+
+class Metrics:
+    """Thread-safe counter/timer registry.
+
+    Producers, the transport and the dataloader all record here; a single
+    registry per pipeline is shared via :func:`metrics` (module default) or
+    injected explicitly for tests.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = collections.defaultdict(float)
+        self._timers: Dict[str, Timer] = collections.defaultdict(Timer)
+        self._t0 = time.perf_counter()
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def add_time(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timers[name].add(seconds)
+
+    def timed(self, name: str) -> "_TimedCtx":
+        return _TimedCtx(self, name)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            return Timer(t.total_s, t.count) if t else Timer()
+
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+            self._t0 = time.perf_counter()
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict of everything, for logging / bench JSON."""
+        with self._lock:
+            out: Dict[str, float] = dict(self._counters)
+            for k, t in self._timers.items():
+                out[f"{k}.total_s"] = t.total_s
+                out[f"{k}.count"] = float(t.count)
+            out["elapsed_s"] = time.perf_counter() - self._t0
+            return out
+
+    # Derived north-star metrics -------------------------------------------
+
+    def samples_per_sec(self) -> float:
+        el = self.elapsed_s()
+        return self.counter("consumer.samples") / el if el > 0 else 0.0
+
+    def stall_fraction(self) -> float:
+        """Fraction of consumer wall time spent waiting on the pipeline."""
+        el = self.elapsed_s()
+        stall = self.timer("consumer.wait").total_s
+        return stall / el if el > 0 else 0.0
+
+    def ingest_bytes_per_sec(self) -> float:
+        el = self.elapsed_s()
+        return self.counter("ingest.bytes") / el if el > 0 else 0.0
+
+
+class _TimedCtx:
+    def __init__(self, m: Metrics, name: str):
+        self._m, self._name = m, name
+
+    def __enter__(self) -> "_TimedCtx":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self._m.add_time(self._name, time.perf_counter() - self._t0)
+
+
+_default = Metrics()
+
+
+def metrics() -> Metrics:
+    """The process-default registry."""
+    return _default

@@ -1,0 +1,45 @@
+"""Structural protocols for producer-loop hooks.
+
+Parity: reference ``ddl/protocols.py:4-18`` defined ``CallbackProtocol`` with
+a name bug — the protocol said ``exec_function`` while the dispatcher and the
+implementations said ``execute_function`` (SURVEY Q2).  Fixed here: protocol,
+dispatcher and skeleton all agree on ``execute_function``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class CallbackProtocol(Protocol):
+    """Hooks dispatched around the producer hot loop.
+
+    Dispatch order per iteration (reference ``ddl/datapusher.py:147-170``):
+    ``on_push_begin`` once, then per window refill: ``global_shuffle`` →
+    ``execute_function`` → (handoff) → ``on_shuffle_end``; ``on_push_end``
+    once at shutdown.  A callback may implement any subset; missing hooks
+    are no-ops.
+    """
+
+    def on_push_begin(self, **kwargs: Any) -> Any: ...
+
+    def global_shuffle(self, **kwargs: Any) -> Any: ...
+
+    def execute_function(self, **kwargs: Any) -> Any: ...
+
+    def on_shuffle_end(self, **kwargs: Any) -> Any: ...
+
+    def on_push_end(self, **kwargs: Any) -> Any: ...
+
+
+#: Hook names considered valid dispatch positions.
+CALLBACK_POSITIONS: tuple[str, ...] = (
+    "on_init",
+    "post_init",
+    "on_push_begin",
+    "global_shuffle",
+    "execute_function",
+    "on_shuffle_end",
+    "on_push_end",
+)

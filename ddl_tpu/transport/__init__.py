@@ -1,0 +1,40 @@
+"""Transport layer: SPSC window rings + control-plane channels.
+
+TPU-native re-design of reference ``ddl/connection.py`` — see
+``ring.py`` (protocol + in-process ring), ``shm_ring.py`` (native C++
+cross-process ring and Python fallback), ``connection.py`` (handshake).
+"""
+
+from ddl_tpu.transport.connection import (
+    ConsumerConnection,
+    ControlChannel,
+    PipeChannel,
+    ProducerConnection,
+    ThreadChannel,
+)
+from ddl_tpu.transport.ring import DEFAULT_TIMEOUT_S, ThreadRing, WindowRing
+from ddl_tpu.transport.shm_ring import (
+    NativeShmRing,
+    PyShmRing,
+    create_shm_ring,
+    make_ring_name,
+    native_available,
+    open_shm_ring,
+)
+
+__all__ = [
+    "ConsumerConnection",
+    "ControlChannel",
+    "DEFAULT_TIMEOUT_S",
+    "NativeShmRing",
+    "PipeChannel",
+    "ProducerConnection",
+    "PyShmRing",
+    "ThreadChannel",
+    "ThreadRing",
+    "WindowRing",
+    "create_shm_ring",
+    "make_ring_name",
+    "native_available",
+    "open_shm_ring",
+]

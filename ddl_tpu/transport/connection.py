@@ -1,0 +1,193 @@
+"""Connection: handshake control plane + per-producer ring data plane.
+
+Parity with reference ``ddl/connection.py``: that class bundled (a) pickled
+metadata send/recv over MPI tag 0 (``connection.py:66-86``), (b) window
+allocation (``:88-139``), (c) the access-epoch token protocol (``:144-182``)
+and (d) shutdown (``:184-187``).  Here the token protocol and window storage
+live in :mod:`ddl_tpu.transport.ring`; this module provides the control
+plane — metadata handshake over mode-appropriate channels — and owns the
+set of rings.
+
+Channel realisations:
+- THREAD mode: ``queue.Queue`` pairs (consumer and producers share a process).
+- PROCESS mode: ``multiprocessing.Pipe`` (pickles metadata exactly as the
+  reference pickled it over ``ssend``, ``connection.py:73``).
+"""
+
+from __future__ import annotations
+
+import abc
+import queue as queue_mod
+from typing import Any, List, Optional, Sequence
+
+from ddl_tpu.exceptions import StallTimeoutError, TransportError
+from ddl_tpu.transport.ring import WindowRing
+from ddl_tpu.types import (
+    MetaData_Consumer_To_Producer,
+    MetaData_Producer_To_Consumer,
+)
+
+_HANDSHAKE_TIMEOUT_S = 600.0
+
+
+class ControlChannel(abc.ABC):
+    """One bidirectional control-plane link (consumer ↔ one producer)."""
+
+    @abc.abstractmethod
+    def send(self, obj: Any) -> None: ...
+
+    @abc.abstractmethod
+    def recv(self, timeout_s: float = _HANDSHAKE_TIMEOUT_S) -> Any: ...
+
+    def close(self) -> None:  # pragma: no cover
+        pass
+
+
+class ThreadChannel(ControlChannel):
+    """In-process channel endpoint over a pair of queues."""
+
+    def __init__(self, tx: "queue_mod.Queue[Any]", rx: "queue_mod.Queue[Any]"):
+        self._tx, self._rx = tx, rx
+
+    @staticmethod
+    def pair() -> tuple["ThreadChannel", "ThreadChannel"]:
+        a: "queue_mod.Queue[Any]" = queue_mod.Queue()
+        b: "queue_mod.Queue[Any]" = queue_mod.Queue()
+        return ThreadChannel(a, b), ThreadChannel(b, a)
+
+    def send(self, obj: Any) -> None:
+        self._tx.put(obj)
+
+    def recv(self, timeout_s: float = _HANDSHAKE_TIMEOUT_S) -> Any:
+        try:
+            return self._rx.get(timeout=timeout_s)
+        except queue_mod.Empty as e:
+            raise StallTimeoutError(f"control recv exceeded {timeout_s}s") from e
+
+
+class PipeChannel(ControlChannel):
+    """Cross-process channel over a ``multiprocessing.Pipe`` end."""
+
+    def __init__(self, conn: Any):
+        self._conn = conn
+
+    @staticmethod
+    def pair() -> tuple["PipeChannel", "PipeChannel"]:
+        import multiprocessing as mp
+
+        a, b = mp.Pipe(duplex=True)
+        return PipeChannel(a), PipeChannel(b)
+
+    def send(self, obj: Any) -> None:
+        self._conn.send(obj)
+
+    def recv(self, timeout_s: float = _HANDSHAKE_TIMEOUT_S) -> Any:
+        if not self._conn.poll(timeout_s):
+            raise StallTimeoutError(f"control recv exceeded {timeout_s}s")
+        return self._conn.recv()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class ConsumerConnection:
+    """Consumer endpoint: broadcasts metadata, collects replies, owns rings.
+
+    Mirrors the consumer half of reference ``Connection``
+    (``connection.py:66-73`` broadcast, ``:82-86`` gather), with rings
+    replacing windows.
+    """
+
+    def __init__(self, channels: Sequence[ControlChannel]):
+        self.channels = list(channels)
+        self.rings: List[WindowRing] = []
+        self.replies: List[MetaData_Producer_To_Consumer] = []
+
+    @property
+    def n_producers(self) -> int:
+        return len(self.channels)
+
+    def send_metadata(self, meta: MetaData_Consumer_To_Producer) -> None:
+        for ch in self.channels:
+            ch.send(meta)
+
+    def recv_metadata_as_consumer(self) -> List[MetaData_Producer_To_Consumer]:
+        replies = [ch.recv() for ch in self.channels]
+        for i, r in enumerate(replies):
+            if not isinstance(r, MetaData_Producer_To_Consumer):
+                raise TransportError(f"bad handshake reply from producer {i}: {r!r}")
+        self.replies = sorted(replies, key=lambda r: r.producer_idx)
+        return self.replies
+
+    def attach_rings(self) -> List[WindowRing]:
+        """Open every producer's ring (by name or by in-process reference)."""
+        from ddl_tpu.transport.shm_ring import open_shm_ring
+
+        self.rings = []
+        for r in self.replies:
+            ref = getattr(r, "ring_ref", None)
+            if isinstance(ref, WindowRing):
+                self.rings.append(ref)
+            elif isinstance(ref, str):
+                self.rings.append(open_shm_ring(ref))
+            else:
+                raise TransportError(f"producer {r.producer_idx} sent no ring_ref")
+        return self.rings
+
+    def shutdown_operation(self) -> None:
+        """Wake every producer with the shutdown flag.
+
+        Replaces the reference's Ibarrier-join trigger
+        (``connection.py:184-187``, SURVEY §3.5): flag-based, idempotent,
+        and observable from any blocked wait.
+        """
+        for ring in self.rings:
+            ring.shutdown()
+
+    def finalize(self) -> None:
+        for ring in self.rings:
+            ring.close()
+        for ch in self.channels:
+            ch.close()
+
+
+class ProducerConnection:
+    """Producer endpoint: one control channel + this producer's ring."""
+
+    def __init__(self, channel: ControlChannel, producer_idx: int,
+                 cross_process: bool):
+        self.channel = channel
+        self.producer_idx = producer_idx
+        self.cross_process = cross_process
+        self.ring: Optional[WindowRing] = None
+
+    def recv_metadata_as_producer(self) -> MetaData_Consumer_To_Producer:
+        meta = self.channel.recv()
+        if not isinstance(meta, MetaData_Consumer_To_Producer):
+            raise TransportError(f"bad handshake metadata: {meta!r}")
+        return meta
+
+    def create_ring(self, nslots: int, slot_bytes: int) -> WindowRing:
+        if self.cross_process:
+            from ddl_tpu.transport.shm_ring import create_shm_ring, make_ring_name
+
+            name = make_ring_name(f"ddl-p{self.producer_idx}")
+            self.ring = create_shm_ring(name, nslots, slot_bytes)
+            self._ring_ref: Any = name
+        else:
+            from ddl_tpu.transport.ring import ThreadRing
+
+            self.ring = ThreadRing(nslots, slot_bytes)
+            self._ring_ref = self.ring
+        return self.ring
+
+    def send_metadata(self, reply: MetaData_Producer_To_Consumer) -> None:
+        reply.ring_ref = self._ring_ref  # type: ignore[attr-defined]
+        self.channel.send(reply)
+
+    def finalize(self) -> None:
+        if self.ring is not None:
+            self.ring.close()
+            if self.cross_process:
+                self.ring.unlink()
+        self.channel.close()

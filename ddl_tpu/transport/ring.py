@@ -1,0 +1,194 @@
+"""Abstract SPSC window ring — the TPU-native ``Connection`` data plane.
+
+This replaces the reference's MPI-3 RMA machinery (``Win.Allocate_shared`` +
+``Lock_all`` passive epochs + zero-byte ``Ssend``/``Issend`` token ping-pong,
+reference ``ddl/connection.py:88-182``) with a single-producer
+single-consumer ring of window-sized slots:
+
+- The reference's "access epoch token" (tag-7 message, ``connection.py:153-182``)
+  becomes a pair of monotonic counters (``committed`` by the producer,
+  ``released`` by the consumer) with acquire/release memory ordering.
+- The reference's one-window-per-producer strict alternation is the
+  ``nslots=1`` special case; ``nslots>=2`` delivers the double-buffering the
+  reference left as a ToDo (reference ``ddl/mpi_dataloader.py:21-28``).
+- The reference's shutdown Ibarrier race (``connection.py:36-37,184-187``)
+  becomes a shutdown flag observed by every blocked wait: any wait returns
+  by raising :class:`ShutdownRequested`, matching the any-time
+  cancellability of ``MPI.Request.Waitany`` + ``Cancel``.
+
+Three interchangeable implementations:
+
+- :class:`ThreadRing` (this module) — in-process, for THREAD mode and tests.
+- ``NativeShmRing`` (``shm_ring.py``) — C++ atomics over POSIX shm, the
+  production cross-process path.
+- ``PyShmRing`` (``shm_ring.py``) — pure-Python fallback with the same
+  memory layout.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+
+from ddl_tpu.exceptions import ShutdownRequested, StallTimeoutError
+
+#: Default wait deadline. The reference had none — a lost peer hung forever
+#: (SURVEY §5.3); 5 minutes is generous for any real refill.
+DEFAULT_TIMEOUT_S = 300.0
+
+
+class WindowRing(abc.ABC):
+    """SPSC ring of fixed-size window slots.
+
+    Producer side: ``acquire_fill() -> slot``, write into ``slot_view``,
+    ``commit(slot, nbytes)``.  Consumer side: ``acquire_drain() -> slot``,
+    read ``slot_view``, ``release(slot)``.  Slots hand off in FIFO order.
+    """
+
+    nslots: int
+    slot_bytes: int
+
+    # -- producer side -----------------------------------------------------
+    @abc.abstractmethod
+    def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        """Block until a free slot is available; return its index."""
+
+    @abc.abstractmethod
+    def commit(self, slot: int, payload_bytes: int) -> None:
+        """Publish a filled slot to the consumer."""
+
+    # -- consumer side -----------------------------------------------------
+    @abc.abstractmethod
+    def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        """Block until a committed slot is available; return its index."""
+
+    @abc.abstractmethod
+    def release(self, slot: int) -> None:
+        """Return a drained slot to the producer."""
+
+    # -- shared ------------------------------------------------------------
+    @abc.abstractmethod
+    def slot_view(self, slot: int) -> np.ndarray:
+        """Zero-copy uint8 view of the slot payload region."""
+
+    @abc.abstractmethod
+    def slot_payload(self, slot: int) -> int:
+        """Committed payload byte count of the slot."""
+
+    @abc.abstractmethod
+    def shutdown(self) -> None:
+        """Wake every blocked wait with :class:`ShutdownRequested`."""
+
+    @abc.abstractmethod
+    def is_shutdown(self) -> bool: ...
+
+    @abc.abstractmethod
+    def stats(self) -> Dict[str, float]:
+        """Stall/progress counters: producer_stall_s, consumer_stall_s,
+        committed, released."""
+
+    def close(self) -> None:  # pragma: no cover - overridden where needed
+        pass
+
+    def unlink(self) -> None:  # pragma: no cover
+        pass
+
+
+class ThreadRing(WindowRing):
+    """In-process ring over plain numpy buffers and a condition variable.
+
+    Backs THREAD mode, where producers are threads of the trainer process —
+    the fix for SURVEY Q9 (the reference silently yielded an empty loader
+    without MPI, reference ``ddl/mpi_dataloader.py:173-174``).
+    """
+
+    def __init__(self, nslots: int, slot_bytes: int):
+        if nslots < 1:
+            raise ValueError("nslots must be >= 1")
+        self.nslots = nslots
+        self.slot_bytes = slot_bytes
+        self._slots = [np.zeros(slot_bytes, dtype=np.uint8) for _ in range(nslots)]
+        self._payload = [0] * nslots
+        self._committed = 0
+        self._released = 0
+        self._shutdown = False
+        self._cond = threading.Condition()
+        self._prod_stall = 0.0
+        self._cons_stall = 0.0
+
+    def _wait(self, pred, timeout_s: float, stall_attr: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            with self._cond:
+                # Shutdown first, matching the native ring: post-shutdown,
+                # trailing committed slots are dropped, not drained.
+                while True:
+                    if self._shutdown:
+                        raise ShutdownRequested()
+                    if pred():
+                        break
+                    remaining = timeout_s - (time.perf_counter() - t0)
+                    if remaining <= 0:
+                        raise StallTimeoutError(
+                            f"ring wait exceeded {timeout_s}s "
+                            f"(committed={self._committed} released={self._released})"
+                        )
+                    self._cond.wait(min(remaining, 0.5))
+        finally:
+            setattr(
+                self, stall_attr,
+                getattr(self, stall_attr) + time.perf_counter() - t0,
+            )
+
+    def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        self._wait(
+            lambda: self._committed - self._released < self.nslots,
+            timeout_s,
+            "_prod_stall",
+        )
+        return self._committed % self.nslots
+
+    def commit(self, slot: int, payload_bytes: int) -> None:
+        with self._cond:
+            assert slot == self._committed % self.nslots, "out-of-order commit"
+            self._payload[slot] = payload_bytes
+            self._committed += 1
+            self._cond.notify_all()
+
+    def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        self._wait(
+            lambda: self._committed > self._released, timeout_s, "_cons_stall"
+        )
+        return self._released % self.nslots
+
+    def release(self, slot: int) -> None:
+        with self._cond:
+            assert slot == self._released % self.nslots, "out-of-order release"
+            self._released += 1
+            self._cond.notify_all()
+
+    def slot_view(self, slot: int) -> np.ndarray:
+        return self._slots[slot]
+
+    def slot_payload(self, slot: int) -> int:
+        return self._payload[slot]
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def is_shutdown(self) -> bool:
+        return self._shutdown
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "producer_stall_s": self._prod_stall,
+            "consumer_stall_s": self._cons_stall,
+            "committed": float(self._committed),
+            "released": float(self._released),
+        }

@@ -1,0 +1,357 @@
+"""Cross-process shared-memory ring: ctypes bindings over the C++ core.
+
+The native library (``csrc/shm_ring.cpp``) is compiled on demand with g++ —
+the ddl_tpu analog of the reference leaning on OpenMPI's native core for its
+shared-memory windows (SURVEY §2.4).  A pure-Python fallback
+(:class:`PyShmRing`) with the same counter protocol over
+``multiprocessing.shared_memory`` exists for environments without a
+toolchain; set ``DDL_TPU_FORCE_PY_RING=1`` to force it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+from ddl_tpu.exceptions import (
+    ShutdownRequested,
+    StallTimeoutError,
+    TransportError,
+)
+from ddl_tpu.transport.ring import DEFAULT_TIMEOUT_S, WindowRing
+
+_CSRC = Path(__file__).parent / "csrc" / "shm_ring.cpp"
+_LIB_PATH = Path(__file__).parent / "csrc" / "_shm_ring.so"
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_native() -> Path:
+    """Compile the native ring if missing/stale. Returns the .so path."""
+    with _build_lock:
+        if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= _CSRC.stat().st_mtime:
+            return _LIB_PATH
+        tmp = _LIB_PATH.with_suffix(f".{os.getpid()}.tmp.so")
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            str(_CSRC), "-o", str(tmp),
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _LIB_PATH)
+        return _LIB_PATH
+
+
+def _load_native() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(str(_build_native()))
+    lib.ddlr_create.restype = ctypes.c_void_p
+    lib.ddlr_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    lib.ddlr_open.restype = ctypes.c_void_p
+    lib.ddlr_open.argtypes = [ctypes.c_char_p]
+    lib.ddlr_acquire_fill.restype = ctypes.c_int
+    lib.ddlr_acquire_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ddlr_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
+    lib.ddlr_acquire_drain.restype = ctypes.c_int
+    lib.ddlr_acquire_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ddlr_release.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ddlr_slot_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.ddlr_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ddlr_slot_payload.restype = ctypes.c_uint64
+    lib.ddlr_slot_payload.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ddlr_shutdown.argtypes = [ctypes.c_void_p]
+    lib.ddlr_is_shutdown.restype = ctypes.c_int
+    lib.ddlr_is_shutdown.argtypes = [ctypes.c_void_p]
+    lib.ddlr_stat.restype = ctypes.c_uint64
+    lib.ddlr_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ddlr_nslots.restype = ctypes.c_uint32
+    lib.ddlr_nslots.argtypes = [ctypes.c_void_p]
+    lib.ddlr_slot_bytes.restype = ctypes.c_uint64
+    lib.ddlr_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.ddlr_close.argtypes = [ctypes.c_void_p]
+    lib.ddlr_unlink.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    if os.environ.get("DDL_TPU_FORCE_PY_RING") == "1":
+        return False
+    try:
+        _load_native()
+        return True
+    except Exception:
+        return False
+
+
+def make_ring_name(prefix: str = "ddl") -> str:
+    """A shm name unique enough to survive crashed prior runs."""
+    return f"/{prefix}-{os.getpid()}-{uuid.uuid4().hex[:12]}"
+
+
+class NativeShmRing(WindowRing):
+    """ctypes wrapper over the C++ seqcount ring (``csrc/shm_ring.cpp``)."""
+
+    def __init__(self, name: str, nslots: int = 0, slot_bytes: int = 0,
+                 create: bool = False):
+        self._lib = _load_native()
+        self.name = name
+        self._closed = False
+        if create:
+            self._h = self._lib.ddlr_create(
+                name.encode(), ctypes.c_uint32(nslots), ctypes.c_uint64(slot_bytes)
+            )
+        else:
+            self._h = self._lib.ddlr_open(name.encode())
+        if not self._h:
+            raise TransportError(
+                f"failed to {'create' if create else 'open'} shm ring {name!r}"
+            )
+        self._owner = create
+        self.nslots = int(self._lib.ddlr_nslots(self._h))
+        self.slot_bytes = int(self._lib.ddlr_slot_bytes(self._h))
+
+    @classmethod
+    def create(cls, name: str, nslots: int, slot_bytes: int) -> "NativeShmRing":
+        return cls(name, nslots, slot_bytes, create=True)
+
+    @classmethod
+    def open(cls, name: str) -> "NativeShmRing":
+        return cls(name, create=False)
+
+    def _check_wait(self, rc: int, timeout_s: float) -> int:
+        if rc == -2:
+            raise ShutdownRequested()
+        if rc == -1:
+            raise StallTimeoutError(f"ring {self.name} wait exceeded {timeout_s}s")
+        return rc
+
+    def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        rc = self._lib.ddlr_acquire_fill(self._h, int(timeout_s * 1e6))
+        return self._check_wait(rc, timeout_s)
+
+    def commit(self, slot: int, payload_bytes: int) -> None:
+        self._lib.ddlr_commit(self._h, slot, payload_bytes)
+
+    def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        rc = self._lib.ddlr_acquire_drain(self._h, int(timeout_s * 1e6))
+        return self._check_wait(rc, timeout_s)
+
+    def release(self, slot: int) -> None:
+        self._lib.ddlr_release(self._h, slot)
+
+    def slot_view(self, slot: int) -> np.ndarray:
+        ptr = self._lib.ddlr_slot_ptr(self._h, slot)
+        buf = (ctypes.c_uint8 * self.slot_bytes).from_address(
+            ctypes.addressof(ptr.contents)
+        )
+        return np.frombuffer(buf, dtype=np.uint8)
+
+    def slot_payload(self, slot: int) -> int:
+        return int(self._lib.ddlr_slot_payload(self._h, slot))
+
+    def shutdown(self) -> None:
+        self._lib.ddlr_shutdown(self._h)
+
+    def is_shutdown(self) -> bool:
+        return bool(self._lib.ddlr_is_shutdown(self._h))
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "producer_stall_s": self._lib.ddlr_stat(self._h, 0) / 1e6,
+            "consumer_stall_s": self._lib.ddlr_stat(self._h, 1) / 1e6,
+            "committed": float(self._lib.ddlr_stat(self._h, 2)),
+            "released": float(self._lib.ddlr_stat(self._h, 3)),
+        }
+
+    def close(self) -> None:
+        # Intentionally does NOT munmap: numpy views created by slot_view
+        # hold raw pointers into the mapping, and unmapping under them would
+        # be a use-after-free. The kernel reclaims mappings at process exit;
+        # unlink() removes the name so the memory is freed once all
+        # processes exit. (Same policy as PyShmRing.close.)
+        self._closed = True
+
+    def unlink(self) -> None:
+        self._lib.ddlr_unlink(self.name.encode())
+
+
+class PyShmRing(WindowRing):
+    """Pure-Python fallback over a raw ``mmap`` of a ``/dev/shm`` file.
+
+    Same counter protocol as the native ring but with Python-level polling.
+    Counter stores are 8-byte aligned single writes with one writer each;
+    this relies on x86-64's total-store-order — on weakly-ordered ISAs
+    (ARM64) the publish order is NOT guaranteed from Python, so the native
+    ring is required there (TPU hosts are x86-64).  Raw mmap is
+    used instead of ``multiprocessing.shared_memory`` so that outstanding
+    numpy views never trip BufferError at teardown and no resource-tracker
+    chatter leaks into user processes.  Slower waits than the native ring —
+    use only where g++ is unavailable.
+    """
+
+    _HDR = 4096  # [0]=committed u64, [8]=released u64, [16]=shutdown u64,
+    #              [24]=nslots u64, [32]=slot_bytes u64, [40]=magic u64
+    #              (written last by the creator), [64+8i]=payload[i]
+    _MAGIC = 0xDD17_00F5_0000_0001  # py-format marker (≠ native kMagic)
+
+    def __init__(self, name: str, nslots: int = 0, slot_bytes: int = 0,
+                 create: bool = False):
+        import mmap
+
+        self.name = name
+        path = f"/dev/shm/{name.lstrip('/')}"
+        if create:
+            total = self._HDR + nslots * slot_bytes
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, total)
+                self._mm = mmap.mmap(fd, total)
+            finally:
+                os.close(fd)
+            self._u64 = np.frombuffer(self._mm, dtype=np.uint64)
+            self._u64[:8] = 0
+            self._u64[3] = nslots
+            self._u64[4] = slot_bytes
+            self._u64[5] = self._MAGIC  # publish: header is now valid
+        else:
+            fd = -1
+            try:
+                for _ in range(2000):  # peer may still be creating it
+                    try:
+                        fd = os.open(path, os.O_RDWR)
+                        break
+                    except FileNotFoundError:
+                        time.sleep(0.001)
+                if fd < 0:
+                    raise TransportError(f"shm ring {name!r} never appeared")
+                total = 0
+                for _ in range(2000):  # ... or still ftruncating it
+                    total = os.fstat(fd).st_size
+                    if total >= self._HDR:
+                        break
+                    time.sleep(0.001)
+                if total < self._HDR:
+                    raise TransportError(f"shm ring {name!r} never grew a header")
+                self._mm = mmap.mmap(fd, total)
+            finally:
+                if fd >= 0:
+                    os.close(fd)
+            self._u64 = np.frombuffer(self._mm, dtype=np.uint64)
+            for _ in range(2000):  # ... or still writing the header
+                if int(self._u64[5]) == self._MAGIC:
+                    break
+                time.sleep(0.001)
+            if int(self._u64[5]) != self._MAGIC:
+                raise TransportError(
+                    f"shm ring {name!r} is not py-format (native-format "
+                    f"segment opened with DDL_TPU_FORCE_PY_RING, or corrupt)"
+                )
+        self._owner = create
+        self.nslots = int(self._u64[3])
+        self.slot_bytes = int(self._u64[4])
+        self._stall = {"producer_stall_s": 0.0, "consumer_stall_s": 0.0}
+
+    create = classmethod(lambda cls, name, nslots, slot_bytes: cls(
+        name, nslots, slot_bytes, create=True))
+    open = classmethod(lambda cls, name: cls(name, create=False))
+
+    def _wait(self, ready, timeout_s: float, key: str) -> int:
+        t0 = time.perf_counter()
+        spins = 0
+        try:
+            while True:
+                if self._u64[2]:
+                    raise ShutdownRequested()
+                slot = ready()
+                if slot is not None:
+                    return slot
+                if time.perf_counter() - t0 > timeout_s:
+                    raise StallTimeoutError(
+                        f"ring {self.name} wait exceeded {timeout_s}s"
+                    )
+                spins += 1
+                if spins > 100:
+                    time.sleep(0.0002)
+        finally:
+            self._stall[key] += time.perf_counter() - t0
+
+    def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        def ready():
+            c, r = int(self._u64[0]), int(self._u64[1])
+            return c % self.nslots if c - r < self.nslots else None
+
+        return self._wait(ready, timeout_s, "producer_stall_s")
+
+    def commit(self, slot: int, payload_bytes: int) -> None:
+        self._u64[8 + slot] = payload_bytes
+        self._u64[0] = self._u64[0] + np.uint64(1)
+
+    def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        def ready():
+            c, r = int(self._u64[0]), int(self._u64[1])
+            return r % self.nslots if c > r else None
+
+        return self._wait(ready, timeout_s, "consumer_stall_s")
+
+    def release(self, slot: int) -> None:
+        self._u64[1] = self._u64[1] + np.uint64(1)
+
+    def slot_view(self, slot: int) -> np.ndarray:
+        off = self._HDR + slot * self.slot_bytes
+        return np.frombuffer(self._mm, dtype=np.uint8,
+                             count=self.slot_bytes, offset=off)
+
+    def slot_payload(self, slot: int) -> int:
+        return int(self._u64[8 + slot])
+
+    def shutdown(self) -> None:
+        self._u64[2] = 1
+
+    def is_shutdown(self) -> bool:
+        return bool(self._u64[2])
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            **self._stall,
+            "committed": float(self._u64[0]),
+            "released": float(self._u64[1]),
+        }
+
+    def close(self) -> None:
+        # The mmap stays mapped until process exit: numpy views handed to
+        # user code may outlive the ring, and unmapping under them would
+        # be a use-after-free. The kernel reclaims at exit.
+        pass
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(f"/dev/shm/{self.name.lstrip('/')}")
+        except OSError:
+            pass
+
+
+def create_shm_ring(name: str, nslots: int, slot_bytes: int) -> WindowRing:
+    """Create the best available cross-process ring (native, else Python)."""
+    if native_available():
+        return NativeShmRing.create(name, nslots, slot_bytes)
+    return PyShmRing.create(name, nslots, slot_bytes)
+
+
+def open_shm_ring(name: str) -> WindowRing:
+    if native_available():
+        return NativeShmRing.open(name)
+    return PyShmRing.open(name)

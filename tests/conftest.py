@@ -1,0 +1,24 @@
+"""Test configuration: simulate an 8-device TPU mesh on CPU.
+
+Must run before any jax import — pytest imports conftest first, so setting
+the env here covers every test module.  Mirrors SURVEY §8.1's test strategy:
+multi-chip behaviour is validated on a virtual CPU mesh
+(``--xla_force_host_platform_device_count``), the real chip is bench-only.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,144 @@
+"""Unit tests: types, exceptions, callback dispatcher, tracing utilities."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from ddl_tpu.datasetwrapper import DataProducerOnInitReturn, ProducerFunctionSkeleton
+from ddl_tpu.exceptions import DDLError, DoesNotMatchError, ShutdownRequested
+from ddl_tpu.types import Marker, RunMode, Topology, WindowSpec, normalize_splits
+from ddl_tpu.utils import execute_callbacks, for_all_methods, with_logging
+
+
+class TestTypes:
+    def test_marker_values(self):
+        # API parity with reference ddl/types.py:35-37
+        assert Marker.END_OF_BATCH.value == 1
+        assert Marker.END_OF_EPOCH.value == 2
+
+    def test_topology_validation(self):
+        t = Topology(n_instances=4, instance_idx=2, n_producers=3)
+        assert t.world_size == 16
+        with pytest.raises(ValueError):
+            Topology(n_instances=0)
+        with pytest.raises(ValueError):
+            Topology(n_instances=2, instance_idx=2)
+
+    def test_window_spec(self):
+        spec = WindowSpec(shape=(128, 10), dtype=np.dtype(np.float32),
+                          splits=(3, 6, 1), batch_size=16)
+        assert spec.nbytes == 128 * 10 * 4
+        assert spec.batches_per_window == 8
+
+    def test_normalize_splits(self):
+        assert normalize_splits(5, 5) == (5,)
+        assert normalize_splits([3, 1, 1], 5) == (3, 1, 1)
+        with pytest.raises(DoesNotMatchError):
+            normalize_splits((3, 1), 5)
+
+    def test_run_modes(self):
+        assert {m.value for m in RunMode} == {"thread", "process", "multihost"}
+
+
+class TestExceptions:
+    def test_does_not_match_ctor_works(self):
+        # The reference's ctor never ran (`__init` typo, SURVEY Q3).
+        e = DoesNotMatchError((1, 2), "mismatch")
+        assert e.value == (1, 2)
+        assert "mismatch" in str(e)
+        assert isinstance(e, DDLError)
+
+
+class _HookA:
+    def __init__(self):
+        self.calls = []
+
+    def on_push_begin(self, **kw):
+        self.calls.append("on_push_begin")
+
+    def execute_function(self, **kw):
+        self.calls.append("execute_function")
+        return "A"
+
+
+class _HookB:
+    def __init__(self):
+        self.calls = []
+
+    def global_shuffle(self, **kw):
+        self.calls.append("global_shuffle")
+        return "B"
+
+
+class TestCallbacks:
+    def test_all_callbacks_run(self):
+        """Regression for SURVEY Q1: the reference dispatched only
+        callbacks[0]; the global shuffler at index 1 never ran."""
+        a, b = _HookA(), _HookB()
+        execute_callbacks([a, b], "global_shuffle")
+        assert b.calls == ["global_shuffle"]  # index-1 callback DID run
+
+    def test_missing_hook_is_noop(self):
+        a = _HookA()
+        assert execute_callbacks([a], "on_shuffle_end") is None
+
+    def test_last_non_none_return_wins(self):
+        assert execute_callbacks([_HookA(), _HookB()], "execute_function") == "A"
+
+    def test_unknown_position_rejected(self):
+        with pytest.raises(ValueError):
+            execute_callbacks([], "exec_function")  # the reference's Q2 typo
+
+
+class TestTracing:
+    def test_with_logging_passthrough_and_debug(self, caplog):
+        @with_logging
+        def f(self, x):
+            return x + 1
+
+        assert f(None, 1) == 2
+        with caplog.at_level(logging.DEBUG, logger="ddl_tpu"):
+            assert f(None, 2) == 3
+        assert any("-> " in r.message for r in caplog.records)
+
+    def test_for_all_methods(self):
+        seen = []
+
+        def deco(fn):
+            def wrapper(*a, **k):
+                seen.append(fn.__name__)
+                return fn(*a, **k)
+
+            return wrapper
+
+        @for_all_methods(deco, exclude=("skip_me",))
+        class C:
+            def hit(self):
+                return 1
+
+            def skip_me(self):
+                return 2
+
+        c = C()
+        assert c.hit() == 1 and c.skip_me() == 2
+        assert seen == ["hit"]
+
+
+class TestProducerFunction:
+    def test_skeleton_contract(self):
+        class P(ProducerFunctionSkeleton):
+            def on_init(self, **kw):
+                return DataProducerOnInitReturn(
+                    nData=8, nValues=4, shape=(8, 4), splits=(3, 1)
+                )
+
+        p = P()
+        r = p.on_init()
+        assert r.dtype == np.float32
+        p.post_init(my_ary=np.zeros((8, 4)))  # default no-ops
+        p.execute_function(my_ary=np.zeros((8, 4)), epoch=0)
+
+    def test_skeleton_is_abstract(self):
+        with pytest.raises(TypeError):
+            ProducerFunctionSkeleton()  # type: ignore[abstract]
