@@ -23,8 +23,23 @@ __version__ = "0.1.0"
 
 __all__ = [
     "DataProducerOnInitReturn",
+    "DistributedDataLoader",
     "Marker",
     "ProducerFunctionSkeleton",
     "RunMode",
     "Topology",
+    "distributed_dataloader",
 ]
+
+
+def __getattr__(name: str):
+    # Lazy imports keep `import ddl_tpu` light and avoid import cycles.
+    if name == "DistributedDataLoader":
+        from ddl_tpu.dataloader import DistributedDataLoader
+
+        return DistributedDataLoader
+    if name == "distributed_dataloader":
+        from ddl_tpu.env import distributed_dataloader
+
+        return distributed_dataloader
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

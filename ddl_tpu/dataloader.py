@@ -1,0 +1,245 @@
+"""Consumer API: the distributed dataloader.
+
+Parity with reference ``ddl/mpi_dataloader.py`` — ``DistributedDataLoader``
+with ``__len__`` / ``__getitem__`` / ``mark`` (``mpi_dataloader.py:107-241``):
+
+- ``__len__`` is ``batches_per_window`` — an "epoch" in the user loop is one
+  window of the current producer (Q7 semantics preserved for API compat;
+  dataset coverage comes from round-robin rotation across epochs).
+- ``__getitem__`` returns a zero-copy tuple of column-split tensors from the
+  current window (reference ``mpi_dataloader.py:179-198``).
+- The user MUST call ``mark(Marker.END_OF_BATCH)`` after every step and
+  ``mark(Marker.END_OF_EPOCH)`` after every epoch; rotation and shutdown
+  are driven off the marks (reference ``mpi_dataloader.py:89-102``).
+
+Fixes over the reference: unequal ``batches_per_window`` across producers is
+rejected at handshake instead of deadlocking later (Q6, reference ToDo at
+``mpi_dataloader.py:223``); single-process THREAD mode is first-class rather
+than a silent empty loader (Q9, ``mpi_dataloader.py:173-174``); output can
+be numpy views, torch tensors, or JAX device arrays (device ingest).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddl_tpu.datasetwrapper import ProducerFunctionSkeleton
+from ddl_tpu.exceptions import DoesNotMatchError
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+from ddl_tpu.transport.connection import ConsumerConnection
+from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer
+
+logger = logging.getLogger("ddl_tpu")
+
+
+class DistributedDataLoader:
+    """Map-style loader over producer window rings.
+
+    Construction performs the consumer half of the handshake
+    (reference ``mpi_dataloader.py:127-172``): broadcast the pickled
+    producer function + batch geometry, gather per-producer window specs,
+    attach rings, and acquire the first window.
+    """
+
+    def __init__(
+        self,
+        data_producer_function: ProducerFunctionSkeleton,
+        batch_size: int,
+        connection: ConsumerConnection,
+        n_epochs: int = 1,
+        global_shuffle_fraction_exchange: float = 0.0,
+        exchange_method: str = "sendrecv_replace",
+        output: str = "torch",
+        device: Any = None,
+        sharding: Any = None,
+        metrics: Optional[Metrics] = None,
+        timeout_s: float = 300.0,
+    ):
+        if output not in ("torch", "numpy", "jax"):
+            raise ValueError(f"output must be torch|numpy|jax, got {output!r}")
+        self.batch_size = batch_size
+        self.n_epochs = n_epochs
+        self.connection = connection
+        self.output = output
+        self.metrics = metrics or default_metrics()
+        self.timeout_s = timeout_s
+        self._epoch = 0
+        self._batches_in_window = 0
+        self._target = 0  # index into connection.rings, round-robin
+        self._cur_slot: Optional[int] = None
+        self._cur_array: Optional[np.ndarray] = None
+        self._finalized = False
+        self._ingestor = None
+        if output == "jax":
+            from ddl_tpu.ingest import DeviceIngestor
+
+            self._ingestor = DeviceIngestor(
+                device=device, sharding=sharding, metrics=self.metrics
+            )
+
+        # -- handshake -----------------------------------------------------
+        connection.send_metadata(
+            MetaData_Consumer_To_Producer(
+                data_producer_function=data_producer_function,
+                batch_size=batch_size,
+                n_epochs=n_epochs,
+                global_shuffle_fraction_exchange=global_shuffle_fraction_exchange,
+                exchange_method=exchange_method,
+            )
+        )
+        replies = connection.recv_metadata_as_consumer()
+        if not replies:
+            raise DoesNotMatchError(0, "no producers connected")
+        bpw = {r.batches_per_window for r in replies}
+        if len(bpw) != 1:
+            # The reference deadlocked here at runtime (Q6, its ToDo at
+            # mpi_dataloader.py:223); we reject at handshake.
+            raise DoesNotMatchError(
+                sorted(bpw),
+                "all producers must report equal batches_per_window",
+            )
+        self.replies = replies
+        self.batches_per_window = replies[0].batches_per_window
+        self._len = self.batches_per_window  # Q7-compatible epoch length
+        self.splits = tuple(replies[0].splits)
+        self.shapes = [tuple(r.shape) for r in replies]
+        self.dtypes = [np.dtype(r.dtype) for r in replies]
+        connection.attach_rings()
+        # First window is acquired lazily on first __getitem__: acquiring
+        # here (as the reference did, mpi_dataloader.py:172) would also make
+        # the FINAL mark of a run block on a whole extra window that
+        # shutdown immediately discards.
+
+    # -- iteration protocol ------------------------------------------------
+
+    @property
+    def n_producers(self) -> int:
+        return self.connection.n_producers
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, idx: int) -> Tuple[Any, ...]:
+        # IndexError terminates Python's implicit iteration protocol in the
+        # user's `for` loop (reference mpi_dataloader.py:180-183).
+        if not isinstance(idx, (int, np.integer)):
+            raise ValueError(f"index must be int, got {type(idx)}")
+        if idx < 0 or idx >= self._len:
+            raise IndexError(idx)
+        if self._finalized:
+            raise RuntimeError("loader is finalized")
+        if self._cur_array is None:
+            self._acquire_current()
+        assert self._cur_array is not None
+        start = self.batch_size * idx
+        batch = self._cur_array[start : start + self.batch_size]
+        self.metrics.incr("consumer.samples", self.batch_size)
+        cols = _split_columns(batch, self.splits)
+        if self.output == "numpy":
+            return cols
+        if self.output == "torch":
+            import torch
+
+            # torch.from_numpy is zero-copy over the ring slot, exactly as
+            # the reference's view over the MPI shared window
+            # (mpi_dataloader.py:192-193).
+            return tuple(torch.from_numpy(c) for c in cols)
+        assert self._ingestor is not None
+        return self._ingestor.put(cols)
+
+    # -- progress marks ------------------------------------------------------
+
+    def mark(self, marker: Marker) -> None:
+        """Report progress (reference ``mpi_dataloader.py:236-241``)."""
+        if marker is Marker.END_OF_BATCH:
+            self._on_batch_end()
+        elif marker is Marker.END_OF_EPOCH:
+            self._on_epoch_end()
+        else:
+            raise ValueError(f"unknown marker {marker!r}")
+
+    def _on_batch_end(self) -> None:
+        self._batches_in_window += 1
+        if self._batches_in_window >= self.batches_per_window:
+            self._batches_in_window = 0
+            self._release_current()
+            self._advance_to_next_producer()
+            # Next window is acquired lazily by the next __getitem__.
+
+    def _on_epoch_end(self) -> None:
+        if self._batches_in_window:
+            # Epoch ended mid-window (user broke out early): discard the
+            # partially consumed window so the next epoch starts on a fresh
+            # window boundary instead of silently re-serving stale batches.
+            self._batches_in_window = 0
+            self._release_current()
+            self._advance_to_next_producer()
+        self._epoch += 1
+        if self._epoch >= self.n_epochs:
+            self.shutdown()
+
+    # -- window rotation (reference mpi_dataloader.py:200-234) -------------
+
+    def _ring(self):
+        return self.connection.rings[self._target]
+
+    def _advance_to_next_producer(self) -> None:
+        self._target = (self._target + 1) % self.n_producers
+
+    def _acquire_current(self) -> None:
+        with self.metrics.timed("consumer.wait"):
+            slot = self._ring().acquire_drain(self.timeout_s)
+        self._cur_slot = slot
+        nbytes = self._ring().slot_payload(slot)
+        shape = self.shapes[self._target]
+        dtype = self.dtypes[self._target]
+        self._cur_array = (
+            self._ring().slot_view(slot)[:nbytes].view(dtype).reshape(shape)
+        )
+        self.metrics.incr("consumer.windows")
+
+    def _release_current(self) -> None:
+        if self._cur_slot is not None:
+            self._ring().release(self._cur_slot)
+            self._cur_slot = None
+            self._cur_array = None
+
+    # -- shutdown (reference mpi_dataloader.py:229-234, §3.5) --------------
+
+    def shutdown(self) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        self._release_current()
+        self.connection.shutdown_operation()
+        self.connection.finalize()
+        logger.debug("consumer: shutdown complete after epoch %d", self._epoch)
+
+    def __del__(self) -> None:  # pragma: no cover - best effort
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+def _split_columns(
+    batch: np.ndarray, splits: Sequence[int]
+) -> Tuple[np.ndarray, ...]:
+    """Split a (B, sum(splits)) window slice into column views.
+
+    The analog of ``torch.split(..., dim=1)`` in the reference consumer
+    (``mpi_dataloader.py:195-197``) — plain numpy slicing, still zero-copy.
+    """
+    out: List[np.ndarray] = []
+    off = 0
+    for w in splits:
+        out.append(batch[:, off : off + w])
+        off += w
+    return tuple(out)

@@ -1,0 +1,245 @@
+"""Topology construction and the role-bifurcating decorator.
+
+Parity with reference ``ddl/ddl_env.py``: there, every MPI rank ran the same
+program and ``@distributed_dataloader`` split ranks into one consumer + N
+producers per instance via communicator color arithmetic
+(``ddl_env.py:33-128``).  TPU-native, there are no ranks to split — the
+decorated main runs in the trainer process and the decorator *spawns* the
+producer workers:
+
+- THREAD mode: producers are daemon threads (single-process first-class —
+  fixes SURVEY Q9).
+- PROCESS mode: producers are spawned host processes; data rides the native
+  shared-memory ring (the reference's one-node shm-domain constraint,
+  ``ddl_env.py:72-73``, holds by construction).
+- MULTIHOST mode: PROCESS per host; ``instance_idx``/``n_instances`` come
+  from ``jax.distributed`` (`jax.process_index/process_count`), the analog
+  of the reference's SLURM sniffing (``ddl_env.py:103-107``).
+
+Environment knobs (the reference used SLURM vars): ``DDL_TPU_MODE``,
+``DDL_TPU_N_PRODUCERS``, ``DDL_TPU_NSLOTS``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import threading
+from typing import Any, Callable, List, Optional
+
+from ddl_tpu.exceptions import TransportError
+from ddl_tpu.transport.connection import (
+    ConsumerConnection,
+    PipeChannel,
+    ProducerConnection,
+    ThreadChannel,
+)
+from ddl_tpu.types import DDL_Env, RunMode, Topology
+
+logger = logging.getLogger("ddl_tpu")
+
+#: Sentinel broadcast to producers when the consumer dies before handshake.
+ABORT = "__ddl_tpu_abort__"
+
+
+def detect_topology(
+    n_producers: Optional[int] = None, mode: Optional[RunMode | str] = None
+) -> Topology:
+    """Build the topology from args + environment.
+
+    The reference derived ``n_instances`` from SLURM env vars
+    (``ddl_env.py:103-107``); here MULTIHOST mode derives it from the JAX
+    process grid, and single-host modes use one instance.
+    """
+    if mode is None:
+        mode = os.environ.get("DDL_TPU_MODE", RunMode.THREAD.value)
+    mode = RunMode(mode) if not isinstance(mode, RunMode) else mode
+    if n_producers is None:
+        n_producers = int(os.environ.get("DDL_TPU_N_PRODUCERS", "2"))
+    if mode is RunMode.MULTIHOST:
+        import jax
+
+        n_instances = jax.process_count()
+        instance_idx = jax.process_index()
+    else:
+        n_instances, instance_idx = 1, 0
+    return Topology(
+        n_instances=n_instances,
+        instance_idx=instance_idx,
+        n_producers=n_producers,
+        mode=mode,
+    )
+
+
+def _producer_main(
+    conn: ProducerConnection,
+    topology: Topology,
+    producer_idx: int,
+    nslots: int,
+    shuffler_factory: Any = None,
+) -> None:
+    """Body of one producer worker (thread or process)."""
+    from ddl_tpu.datapusher import DataPusher
+
+    try:
+        pusher = DataPusher(
+            conn,
+            topology,
+            producer_idx,
+            nslots=nslots,
+            shuffler_factory=shuffler_factory,
+        )
+    except TransportError:
+        # Consumer aborted before/during handshake (ABORT sentinel arrives
+        # as non-metadata). Nothing to clean up beyond the channel.
+        conn.channel.close()
+        return
+    except Exception as e:
+        # Handshake-time user error (bad on_init, bad geometry): ship the
+        # exception to the consumer so it fails fast instead of timing out.
+        try:
+            conn.channel.send(e)
+        except Exception:
+            # Exception not picklable (open handles, locks): ship a
+            # picklable surrogate carrying the traceback text instead.
+            import traceback
+
+            try:
+                conn.channel.send(
+                    TransportError(
+                        f"producer {producer_idx} handshake failure "
+                        f"(original unpicklable):\n{traceback.format_exc()}"
+                    )
+                )
+            except Exception:
+                pass
+        logger.exception("producer %d failed during handshake", producer_idx)
+        return
+    pusher.push_data()
+
+
+def _process_entry(
+    pipe_end: Any,
+    topology: Topology,
+    producer_idx: int,
+    nslots: int,
+    shuffler_factory: Any = None,
+) -> None:
+    """Top-level spawn target (must be importable for pickling)."""
+    conn = ProducerConnection(
+        PipeChannel(pipe_end), producer_idx, cross_process=True
+    )
+    _producer_main(conn, topology, producer_idx, nslots, shuffler_factory)
+
+
+class WorkerSet:
+    """The spawned producer workers + consumer-side connection."""
+
+    def __init__(self, topology: Topology, nslots: int,
+                 shuffler_factory: Any = None):
+        self.topology = topology
+        self.threads: List[threading.Thread] = []
+        self.processes: List[Any] = []
+        channels = []
+        if topology.mode is RunMode.THREAD:
+            for idx in range(topology.n_producers):
+                consumer_end, producer_end = ThreadChannel.pair()
+                channels.append(consumer_end)
+                conn = ProducerConnection(
+                    producer_end, idx + 1, cross_process=False
+                )
+                t = threading.Thread(
+                    target=_producer_main,
+                    args=(conn, topology, idx + 1, nslots, shuffler_factory),
+                    name=f"ddl-producer-{idx + 1}",
+                    daemon=True,
+                )
+                t.start()
+                self.threads.append(t)
+        else:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            for idx in range(topology.n_producers):
+                parent_end, child_end = mp.Pipe(duplex=True)
+                channels.append(PipeChannel(parent_end))
+                # shuffler_factory must be picklable: it crosses the spawn
+                # boundary exactly like the user's producer function.
+                p = ctx.Process(
+                    target=_process_entry,
+                    args=(child_end, topology, idx + 1, nslots, shuffler_factory),
+                    name=f"ddl-producer-{idx + 1}",
+                    daemon=True,
+                )
+                p.start()
+                # Close the parent's copy of the child end so a dead
+                # producer surfaces as EOF on the channel, not a timeout.
+                child_end.close()
+                self.processes.append(p)
+        self.connection = ConsumerConnection(channels)
+
+    def abort(self) -> None:
+        """Wake producers that may still be blocked in the handshake."""
+        for ch in self.connection.channels:
+            try:
+                ch.send(ABORT)
+            except Exception:
+                pass
+        self.connection.shutdown_operation()
+
+    def join(self, timeout_s: float = 30.0) -> None:
+        for t in self.threads:
+            t.join(timeout_s)
+        for p in self.processes:
+            p.join(timeout_s)
+            if p.is_alive():  # pragma: no cover - last resort
+                p.terminate()
+
+
+def distributed_dataloader(
+    func: Optional[Callable[..., Any]] = None,
+    *,
+    n_producers: Optional[int] = None,
+    mode: Optional[RunMode | str] = None,
+    nslots: Optional[int] = None,
+    shuffler_factory: Any = None,
+) -> Callable[..., Any]:
+    """Decorator running ``func`` as the consumer with producers alongside.
+
+    API parity: reference ``ddl/ddl_env.py:100-128`` appended
+    ``(mpi_env, connection)`` to the user function's args; here a single
+    :class:`DDL_Env` (topology + consumer connection) is appended.
+    Returns ``func``'s return value after all producers have exited.
+    """
+
+    def deco(f: Callable[..., Any]) -> Callable[..., Any]:
+        @functools.wraps(f)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            topology = detect_topology(n_producers, mode)
+            depth = nslots or int(os.environ.get("DDL_TPU_NSLOTS", "2"))
+            workers = WorkerSet(topology, depth, shuffler_factory)
+            env = DDL_Env(topology=topology, connection=workers.connection)
+            logger.info(
+                "ddl_tpu: %s mode, %d producer(s), instance %d/%d, %d slot(s)",
+                topology.mode.value,
+                topology.n_producers,
+                topology.instance_idx,
+                topology.n_instances,
+                depth,
+            )
+            try:
+                result = f(*args, env, **kwargs)
+            finally:
+                # Idempotent: wakes producers still blocked anywhere —
+                # pre-handshake (ABORT sentinel) or in a ring wait
+                # (shutdown flag). Producers already exited ignore both.
+                workers.abort()
+                workers.join()
+            return result
+
+        return wrapper
+
+    if func is not None:
+        return deco(func)
+    return deco

@@ -1,0 +1,99 @@
+"""Device ingest: host windows → TPU HBM.
+
+The reference stopped at host memory — GPU transfer was left to the user
+(commented out in its harness, reference ``tests/run_ddl.py:233-235``).  On
+TPU the HBM hop is mandatory, so hiding it is a core feature
+(SURVEY §8.3 "hard part #3"):
+
+- :class:`DeviceIngestor` — async ``device_put`` of host batches onto a
+  device or a sharded mesh (``jax.device_put`` returns immediately; the
+  transfer overlaps subsequent host work).  This backs the loader's
+  ``output="jax"`` mode.
+- :class:`PrefetchIterator` — keeps N transfers in flight ahead of
+  compute; used by training loops and the benchmark harness around any
+  host-batch iterator.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+
+class DeviceIngestor:
+    """Puts host batches onto a device (or a sharded mesh) asynchronously.
+
+    With ``sharding`` set (a ``jax.sharding.Sharding``), batches land
+    sharded across the mesh — the data-parallel ingest path.  Otherwise
+    they land on ``device`` (default: first local device).
+    """
+
+    def __init__(
+        self,
+        device: Any = None,
+        sharding: Any = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        import jax
+
+        self._jax = jax
+        self.sharding = sharding
+        self.device = device
+        if sharding is None and device is None:
+            self.device = jax.local_devices()[0]
+        self.metrics = metrics or default_metrics()
+
+    def put(self, cols: Sequence[np.ndarray]) -> Tuple[Any, ...]:
+        """Transfer a tuple of column arrays; returns JAX arrays.
+
+        ``device_put`` is async — the returned arrays are futures whose
+        transfers overlap subsequent host work.  Columns are copied out of
+        the ring slot first (the transfer source must stay valid after the
+        slot is released back to the producer).
+        """
+        target = self.sharding if self.sharding is not None else self.device
+        out = tuple(
+            self._jax.device_put(np.ascontiguousarray(c), target) for c in cols
+        )
+        self.metrics.incr(
+            "ingest.bytes", float(sum(int(c.nbytes) for c in cols))
+        )
+        self.metrics.incr("ingest.batches")
+        return out
+
+
+class PrefetchIterator:
+    """Wrap a batch iterator, keeping ``depth`` device transfers in flight.
+
+    The standard TPU input recipe: while step k computes, batch k+1 is
+    already crossing PCIe/DMA into HBM.
+    """
+
+    def __init__(
+        self,
+        it: Any,
+        ingestor: DeviceIngestor,
+        depth: int = 2,
+    ):
+        self._it = iter(it)
+        self._ingestor = ingestor
+        self._depth = max(1, depth)
+        self._queue: collections.deque = collections.deque()
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        while len(self._queue) < self._depth:
+            try:
+                host_batch = next(self._it)
+            except StopIteration:
+                break
+            self._queue.append(self._ingestor.put(host_batch))
+        if not self._queue:
+            raise StopIteration
+        return self._queue.popleft()

@@ -84,7 +84,12 @@ class PipeChannel(ControlChannel):
     def recv(self, timeout_s: float = _HANDSHAKE_TIMEOUT_S) -> Any:
         if not self._conn.poll(timeout_s):
             raise StallTimeoutError(f"control recv exceeded {timeout_s}s")
-        return self._conn.recv()
+        try:
+            return self._conn.recv()
+        except EOFError as e:
+            # Peer process died with the channel open — fail fast instead
+            # of pretending the handshake may still complete.
+            raise TransportError("control channel peer closed (process died)") from e
 
     def close(self) -> None:
         self._conn.close()
@@ -113,10 +118,20 @@ class ConsumerConnection:
 
     def recv_metadata_as_consumer(self) -> List[MetaData_Producer_To_Consumer]:
         replies = [ch.recv() for ch in self.channels]
+        # Record the valid replies FIRST: even when some producer failed,
+        # shutdown_operation must be able to reach the healthy producers'
+        # rings (via ring_ref) to wake them — otherwise an abort after a
+        # partial handshake leaves them blocked until their wait timeout.
+        self.replies = sorted(
+            (r for r in replies if isinstance(r, MetaData_Producer_To_Consumer)),
+            key=lambda r: r.producer_idx,
+        )
         for i, r in enumerate(replies):
+            if isinstance(r, Exception):
+                # A producer shipped its handshake-time failure to us.
+                raise TransportError(f"producer {i} failed during handshake") from r
             if not isinstance(r, MetaData_Producer_To_Consumer):
                 raise TransportError(f"bad handshake reply from producer {i}: {r!r}")
-        self.replies = sorted(replies, key=lambda r: r.producer_idx)
         return self.replies
 
     def attach_rings(self) -> List[WindowRing]:
@@ -139,9 +154,25 @@ class ConsumerConnection:
 
         Replaces the reference's Ibarrier-join trigger
         (``connection.py:184-187``, SURVEY §3.5): flag-based, idempotent,
-        and observable from any blocked wait.
+        and observable from any blocked wait.  When rings were never
+        attached (handshake failed mid-way), reachable rings are resolved
+        from the recorded replies so healthy producers still wake.
         """
-        for ring in self.rings:
+        rings = self.rings
+        if not rings and self.replies:
+            from ddl_tpu.transport.shm_ring import open_shm_ring
+
+            rings = []
+            for r in self.replies:
+                ref = getattr(r, "ring_ref", None)
+                try:
+                    if isinstance(ref, WindowRing):
+                        rings.append(ref)
+                    elif isinstance(ref, str):
+                        rings.append(open_shm_ring(ref))
+                except Exception:  # pragma: no cover - best-effort wake
+                    pass
+        for ring in rings:
             ring.shutdown()
 
     def finalize(self) -> None:

@@ -1,0 +1,225 @@
+"""End-to-end pipeline tests: decorator → producers → rings → dataloader.
+
+Covers the reference's only executable spec — a multi-worker drain loop
+completing without deadlock (reference ``tests/test_ddl.py:9-28``) — plus
+the unit-level cases the reference never had: rotation order, zero-copy
+outputs, handshake validation (Q6), abort paths, single-slot parity mode.
+"""
+
+from typing import Any
+
+import numpy as np
+import pytest
+
+from ddl_tpu import (
+    DataProducerOnInitReturn,
+    DistributedDataLoader,
+    Marker,
+    ProducerFunctionSkeleton,
+    distributed_dataloader,
+)
+from ddl_tpu.exceptions import TransportError
+
+
+class TaggedProducer(ProducerFunctionSkeleton):
+    """Windows tagged with producer_idx so tests can observe rotation."""
+
+    def __init__(self, n_data=64, n_values=4, bad_ndata_for=None):
+        self.n_data = n_data
+        self.n_values = n_values
+        self.bad_ndata_for = bad_ndata_for  # producer_idx -> different nData
+        self.idx = 0
+
+    def on_init(self, producer_idx=0, **kw) -> DataProducerOnInitReturn:
+        self.idx = producer_idx
+        n = self.n_data
+        if self.bad_ndata_for == producer_idx:
+            n = self.n_data * 2  # triggers unequal batches_per_window
+        return DataProducerOnInitReturn(
+            nData=n, nValues=self.n_values, shape=(n, self.n_values),
+            splits=(self.n_values - 1, 1),
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = float(self.idx)
+        my_ary[:, -1] = np.arange(my_ary.shape[0])
+
+    def execute_function(self, my_ary, iteration=0, **kw):
+        my_ary[:, 0] = float(self.idx) + iteration
+
+
+def drain(loader, n_epochs):
+    seen = []
+    for _ in range(n_epochs):
+        for batch in loader:
+            seen.append(tuple(np.asarray(c).copy() for c in batch))
+            loader.mark(Marker.END_OF_BATCH)
+        loader.mark(Marker.END_OF_EPOCH)
+    return seen
+
+
+class TestThreadModeE2E:
+    def test_drain_all_epochs(self):
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(), batch_size=16, connection=env.connection,
+                n_epochs=3, output="numpy",
+            )
+            assert len(loader) == 4  # 64/16, Q7 semantics: epoch == window
+            return drain(loader, 3)
+
+        seen = main()
+        assert len(seen) == 12  # 3 epochs x 4 batches
+        for feats, tag in seen:
+            assert feats.shape == (16, 3) and tag.shape == (16, 1)
+
+    def test_round_robin_rotation(self):
+        """Consecutive windows come from different producers, round-robin
+        (reference mpi_dataloader.py:213-218)."""
+
+        @distributed_dataloader(n_producers=3, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(n_data=16), batch_size=16,
+                connection=env.connection, n_epochs=6, output="numpy",
+            )
+            tags = []
+            for _ in range(6):
+                for feats, _ in loader:
+                    # col0 = idx + iteration; idx in {1,2,3}
+                    tags.append(int(feats[0, 1]))  # col1 untouched: pure idx
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return tags
+
+        tags = main()
+        assert tags == [1, 2, 3, 1, 2, 3]
+
+    def test_single_producer_single_slot(self):
+        """nslots=1 = reference-style strict alternation; still drains."""
+
+        @distributed_dataloader(n_producers=1, mode="thread", nslots=1)
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(), batch_size=32, connection=env.connection,
+                n_epochs=2, output="numpy",
+            )
+            return drain(loader, 2)
+
+        assert len(main()) == 4
+
+    def test_torch_output_zero_copy(self):
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(), batch_size=64, connection=env.connection,
+                n_epochs=1, output="torch",
+            )
+            import torch
+
+            (feats, tag) = loader[0]
+            assert isinstance(feats, torch.Tensor)
+            # Zero-copy: the tensor aliases the ring slot (shares memory
+            # with the numpy view of the window).
+            base = loader._cur_array
+            assert feats.data_ptr() == base[:, :3].__array_interface__["data"][0]
+            loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+
+        main()
+
+    def test_jax_output_lands_on_device(self):
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(), batch_size=64, connection=env.connection,
+                n_epochs=1, output="jax",
+            )
+            import jax
+
+            feats, tag = loader[0]
+            assert isinstance(feats, jax.Array)
+            assert feats.shape == (64, 3)
+            np.testing.assert_array_equal(np.asarray(tag)[:, 0], np.arange(64))
+            loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+
+        main()
+
+    def test_getitem_bounds(self):
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(), batch_size=16, connection=env.connection,
+                n_epochs=1, output="numpy",
+            )
+            with pytest.raises(IndexError):
+                loader[len(loader)]
+            with pytest.raises(ValueError):
+                loader["0"]  # type: ignore[index]
+            drain(loader, 1)
+
+        main()
+
+
+class TestHandshakeValidation:
+    def test_unequal_batches_per_window_rejected(self):
+        """Q6 fix: the reference deadlocked; we reject at handshake."""
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            return DistributedDataLoader(
+                TaggedProducer(bad_ndata_for=2), batch_size=16,
+                connection=env.connection, n_epochs=1,
+            )
+
+        from ddl_tpu.exceptions import DoesNotMatchError
+
+        with pytest.raises(DoesNotMatchError):
+            main()
+
+    def test_producer_on_init_error_reaches_consumer(self):
+        class Broken(ProducerFunctionSkeleton):
+            def on_init(self, **kw):
+                raise RuntimeError("shard missing")
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            return DistributedDataLoader(
+                Broken(), batch_size=4, connection=env.connection, n_epochs=1
+            )
+
+        with pytest.raises(TransportError, match="failed during handshake"):
+            main()
+
+    def test_user_func_exception_does_not_hang(self):
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            raise RuntimeError("user bug before loader creation")
+
+        with pytest.raises(RuntimeError, match="user bug"):
+            main()  # must return promptly — abort wakes handshaking producers
+
+
+class TestProcessModeE2E:
+    # Deadlock gate: every blocked transport wait is bounded (300 s default
+    # ring timeout, 600 s handshake timeout), so a drain deadlock surfaces
+    # as StallTimeoutError rather than a hang — no pytest-timeout needed.
+    def test_process_mode_drain(self):
+        """The reference CI gate, TPU-native: spawned producer processes,
+        native shm rings, full drain, exit clean."""
+
+        @distributed_dataloader(n_producers=2, mode="process")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(), batch_size=16, connection=env.connection,
+                n_epochs=2, output="numpy",
+            )
+            return drain(loader, 2)
+
+        seen = main()
+        assert len(seen) == 8
+        # Window content produced in a different PROCESS arrived intact.
+        feats, tag = seen[0]
+        assert np.all(tag[:, 0] == np.arange(16))

@@ -1,0 +1,83 @@
+"""Ingest path tests: DeviceIngestor, PrefetchIterator, epoch resync."""
+
+import numpy as np
+
+from ddl_tpu import (
+    DataProducerOnInitReturn,
+    DistributedDataLoader,
+    Marker,
+    ProducerFunctionSkeleton,
+    distributed_dataloader,
+)
+from ddl_tpu.ingest import DeviceIngestor, PrefetchIterator
+
+
+class SeqProducer(ProducerFunctionSkeleton):
+    def on_init(self, producer_idx=0, **kw):
+        return DataProducerOnInitReturn(
+            nData=32, nValues=4, shape=(32, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:, -1] = np.arange(32)
+
+
+class TestDeviceIngestor:
+    def test_put_returns_device_arrays(self):
+        import jax
+
+        ing = DeviceIngestor()
+        cols = (np.ones((4, 3), np.float32), np.zeros((4, 1), np.float32))
+        a, b = ing.put(cols)
+        assert isinstance(a, jax.Array)
+        np.testing.assert_array_equal(np.asarray(a), cols[0])
+
+    def test_sharded_put(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        sharding = NamedSharding(mesh, P("dp"))
+        ing = DeviceIngestor(sharding=sharding)
+        (a,) = ing.put((np.ones((16, 3), np.float32),))
+        assert a.sharding == sharding
+        assert len(a.addressable_shards) == len(jax.devices())
+
+
+class TestPrefetchIterator:
+    def test_order_and_exhaustion(self):
+        batches = [(np.full((2, 2), i, np.float32),) for i in range(7)]
+        out = list(PrefetchIterator(iter(batches), DeviceIngestor(), depth=3))
+        assert len(out) == 7
+        for i, (a,) in enumerate(out):
+            assert float(np.asarray(a)[0, 0]) == i
+
+    def test_empty_iterator(self):
+        assert list(PrefetchIterator(iter([]), DeviceIngestor())) == []
+
+
+class TestEarlyEpochEnd:
+    def test_mid_window_epoch_end_resyncs(self):
+        """Breaking an epoch early must not re-serve the stale window."""
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=8, connection=env.connection,
+                n_epochs=2, output="numpy",
+            )
+            # Epoch 0: consume only 2 of 4 batches, then end the epoch.
+            for i in range(2):
+                loader[i]
+                loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+            # Epoch 1: a full drain must start at a fresh window boundary.
+            count = 0
+            for _ in loader:
+                loader.mark(Marker.END_OF_BATCH)
+                count += 1
+            loader.mark(Marker.END_OF_EPOCH)
+            assert loader._batches_in_window == 0
+            return count
+
+        assert main() == 4
