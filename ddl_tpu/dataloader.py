@@ -104,7 +104,9 @@ class DistributedDataLoader:
         self.replies = replies
         self.batches_per_window = replies[0].batches_per_window
         self._len = self.batches_per_window  # Q7-compatible epoch length
-        self.splits = tuple(replies[0].splits)
+        # Geometry is per-producer: heterogeneous column layouts are served
+        # correctly rather than silently mis-split with producer 0's spec.
+        self.splits_per_producer = [tuple(r.splits) for r in replies]
         self.shapes = [tuple(r.shape) for r in replies]
         self.dtypes = [np.dtype(r.dtype) for r in replies]
         connection.attach_rings()
@@ -141,7 +143,7 @@ class DistributedDataLoader:
         start = self.batch_size * idx
         batch = self._cur_array[start : start + self.batch_size]
         self.metrics.incr("consumer.samples", self.batch_size)
-        cols = _split_columns(batch, self.splits)
+        cols = _split_columns(batch, self.splits_per_producer[self._target])
         if self.output == "numpy":
             return cols
         if self.output == "torch":

@@ -52,18 +52,62 @@ class DeviceIngestor:
 
         ``device_put`` is async — the returned arrays are futures whose
         transfers overlap subsequent host work.  Columns are copied out of
-        the ring slot first (the transfer source must stay valid after the
-        slot is released back to the producer).
+        the ring slot first: the transfer source must stay valid after the
+        slot is released back to the producer, so an explicit copy is
+        mandatory (``ascontiguousarray`` would pass an already-contiguous
+        slot view through uncopied and the producer would overwrite it
+        mid-transfer).
         """
         target = self.sharding if self.sharding is not None else self.device
         out = tuple(
-            self._jax.device_put(np.ascontiguousarray(c), target) for c in cols
+            self._jax.device_put(np.array(c, copy=True), target) for c in cols
         )
         self.metrics.incr(
             "ingest.bytes", float(sum(int(c.nbytes) for c in cols))
         )
         self.metrics.incr("ingest.batches")
         return out
+
+
+def make_global_array(
+    local_batch: np.ndarray, sharding: Any, axis: str = "dp"
+) -> Any:
+    """Assemble a process-local host batch into a global dp-sharded array.
+
+    Multihost ingest: every host's loader drains its own producers'
+    windows (the per-host shard of the global batch), and this stitches
+    them into one global ``jax.Array`` without gathering — the TPU analog
+    of the reference's per-instance window ownership
+    (reference ``ddl/ddl_env.py:45-50``: each trainer only ever reads its
+    own block's producers).
+
+    Single-process (including the 8-device CPU sim), the local batch IS the
+    global batch and this is a sharded ``device_put``.
+    """
+    import jax
+
+    # Copy before the async transfer: the input is typically a view of a
+    # ring slot that the producer will refill once the caller releases it.
+    local_batch = np.array(local_batch, copy=True)
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def north_star_report(metrics: Optional[Metrics] = None) -> dict:
+    """The BASELINE.md metric set, computed from the shared registry.
+
+    Note ``ingest_bytes_per_sec`` counts *device transfers* only — it stays
+    zero in host-output (numpy/torch) runs by design.
+    """
+    m = metrics or default_metrics()
+    return {
+        "samples_per_sec": m.samples_per_sec(),
+        "stall_fraction": m.stall_fraction(),
+        "ingest_bytes_per_sec": m.ingest_bytes_per_sec(),
+        "windows": m.counter("consumer.windows"),
+        "elapsed_s": m.elapsed_s(),
+    }
 
 
 class PrefetchIterator:

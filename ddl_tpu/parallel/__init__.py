@@ -1,0 +1,15 @@
+"""Parallelism layer: meshes, collectives, sharded training utilities.
+
+TPU-native re-design of the reference's MPI topology + exchange machinery
+(SURVEY §2.3): data parallelism and the global-shuffle peer group become
+mesh axes; collectives are XLA ops inserted by ``shard_map``/``pjit``.
+"""
+
+from ddl_tpu.parallel.collectives import DeviceGlobalShuffler
+from ddl_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+
+__all__ = [
+    "DeviceGlobalShuffler",
+    "data_parallel_mesh",
+    "make_mesh",
+]

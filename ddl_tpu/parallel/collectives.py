@@ -1,0 +1,159 @@
+"""Device-side collectives: the TPU-native global shuffle.
+
+This is the re-imagining of reference ``ddl/shuffle.py``'s MPI exchange
+(``Sendrecv_replace`` between same-index producers across instances,
+``shuffle.py:92-108``): the exchange block of every instance's window lives
+dp-sharded in HBM, and one jitted ``shard_map`` moves the lanes along the
+shared permutation with ``lax.ppermute`` — riding ICI/DCN, overlapping with
+compute, with zero host involvement.  The ``all_to_all`` strategy (the
+reference's never-finished second method, SURVEY Q8) redistributes the
+exchange block uniformly across *all* instances in one collective.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import numpy as np
+
+from ddl_tpu.shuffle import (
+    exchange_permutation,
+    exchange_slices,
+    inverse_permutation,
+)
+
+
+def _ppermute_pairs(p: np.ndarray) -> Tuple[Tuple[int, int], ...]:
+    return tuple((int(i), int(pi)) for i, pi in enumerate(p))
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sendrecv_step(
+    mesh_key: Any, axis: str, num_exchange: int, perm: Tuple[int, ...]
+):
+    """Jitted window-shuffle step for one permutation (cached per perm)."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh_key.mesh
+    p = np.array(perm)
+    pinv = inverse_permutation(p)
+    lane_a, lane_b = exchange_slices(num_exchange)
+
+    def shard_fn(window: jax.Array) -> jax.Array:
+        # window: (nData_per_instance, n_values) — this instance's shard.
+        a = jax.lax.ppermute(window[lane_a], axis, _ppermute_pairs(p))
+        b = jax.lax.ppermute(window[lane_b], axis, _ppermute_pairs(pinv))
+        return jax.lax.concatenate(
+            [a, b, window[lane_b.stop :]], dimension=0
+        )
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_rep=False,
+    )
+    spec = NamedSharding(mesh, P(axis))
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_all_to_all_step(mesh_key: Any, axis: str, num_exchange: int):
+    """All-to-all strategy: every instance scatters its exchange block
+    uniformly to all instances and gathers one sub-block from each."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh_key.mesh
+    n = mesh.shape[axis]
+    k = num_exchange - (num_exchange % n)  # rows divisible by n
+
+    def shard_fn(window: jax.Array) -> jax.Array:
+        block = window[:k].reshape(n, k // n, window.shape[1])
+        mixed = jax.lax.all_to_all(
+            block, axis, split_axis=0, concat_axis=0, tiled=False
+        )
+        return jax.lax.concatenate(
+            [mixed.reshape(k, window.shape[1]), window[k:]], dimension=0
+        )
+
+    fn = shard_map(
+        shard_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_rep=False,
+    )
+    spec = NamedSharding(mesh, P(axis))
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
+class _MeshKey:
+    """Hashable wrapper so lru_cache can key on a Mesh."""
+
+    def __init__(self, mesh: Any):
+        self.mesh = mesh
+
+    def __hash__(self) -> int:
+        return hash((tuple(self.mesh.axis_names), self.mesh.devices.tobytes()))
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, _MeshKey)
+            and self.mesh.axis_names == other.mesh.axis_names
+            and bool(np.all(self.mesh.devices == other.mesh.devices))
+        )
+
+
+class DeviceGlobalShuffler:
+    """Per-round device-side global shuffle over a dp-sharded window.
+
+    Usage: the trainer holds the global window as one dp-sharded array
+    (instances × window rows).  Each round, ``shuffle(window)`` exchanges
+    the lanes along a fresh shared permutation — the device analog of the
+    producer-side loop in reference ``datapusher.py:152`` +
+    ``shuffle.py:92-108``.
+    """
+
+    def __init__(
+        self,
+        mesh: Any,
+        axis: str = "dp",
+        num_exchange: int = 0,
+        method: str = "sendrecv_replace",
+        seed: int = 0,
+    ):
+        from ddl_tpu.shuffle import EXCHANGE_METHODS
+
+        if method not in EXCHANGE_METHODS:
+            raise NotImplementedError(
+                f"method {method!r}; valid: {EXCHANGE_METHODS}"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.num_exchange = num_exchange
+        self.method = method
+        self.seed = seed
+        self._round = 0
+        self._key = _MeshKey(mesh)
+
+    @property
+    def n_instances(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def shuffle(self, window: Any) -> Any:
+        """One exchange round; returns the window with lanes exchanged."""
+        n = self.n_instances
+        if n <= 1 or self.num_exchange < 2:
+            return window
+        if self.method == "all_to_all":
+            step = _build_all_to_all_step(self._key, self.axis, self.num_exchange)
+        else:
+            perm = exchange_permutation(n, self.seed, self._round)
+            step = _build_sendrecv_step(
+                self._key, self.axis, self.num_exchange, tuple(int(x) for x in perm)
+            )
+        self._round += 1
+        return step(window)

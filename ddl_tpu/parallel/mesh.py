@@ -1,0 +1,62 @@
+"""Device mesh construction helpers.
+
+The TPU-native replacement for the reference's communicator topology
+(reference ``ddl/ddl_env.py:33-98``): where MPI split ``COMM_WORLD`` into
+per-GPU blocks and cross-block "nth-pusher" rings, a TPU program lays out a
+``jax.sharding.Mesh`` and lets XLA insert the collectives.  The mesh axes
+used across ddl_tpu:
+
+- ``dp``   — data parallel / loader instances (the analog of the
+  reference's one-trainer-per-GPU blocks; the global-shuffle peer group,
+  analog of ``comm_nth_pusher``, is this axis).
+- ``fsdp`` — parameter sharding (ZeRO-style) for the model examples.
+- ``tp``   — tensor parallel.
+- ``sp``   — sequence/context parallel (ring attention).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+):
+    """Build a Mesh with named axes; sizes must multiply to #devices.
+
+    ``axes=None`` → a 1-axis ``dp`` mesh over every device.  An axis size
+    of ``-1`` is inferred from the device count (like a reshape).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {int(np.prod(sizes))} "
+            f"devices, have {n}"
+        )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(n: Optional[int] = None):
+    """1-axis ``dp`` mesh over the first n (default: all) devices."""
+    import jax
+
+    devices = jax.devices()[: n or None]
+    return make_mesh({"dp": len(devices)}, devices)

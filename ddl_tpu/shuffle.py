@@ -1,0 +1,180 @@
+"""Global shuffle: cross-instance sample exchange.
+
+Parity with reference ``ddl/shuffle.py``: between window refills, the k-th
+producer of every instance exchanges a slice of its samples with partner
+instances chosen by a *shared* random permutation — every peer derives the
+same permutation independently from a common seed (reference
+``shuffle.py:28-30``), so no coordination round is needed.  The permutation
+must have no self-sends and no 2-cycles (reference ``shuffle.py:52-72``),
+except n=2 where the swap is the only option (reference ``shuffle.py:44-48``).
+
+Two transports implement the exchange:
+
+- :class:`ThreadExchangeShuffler` (here) — host-side rendezvous for
+  THREAD-mode simulated multi-instance topologies and unit tests.
+- ``ddl_tpu.parallel.collectives`` — the TPU path: ``ppermute`` /
+  ``all_to_all`` over the instance mesh axis riding ICI/DCN, replacing the
+  reference's ``Sendrecv_replace`` (``shuffle.py:92-108``).
+
+Unlike the reference — where the registered shuffler was unreachable dead
+code (SURVEY Q1) and the alternative strategy lived in a commented-out
+string (Q8) — both strategies here are real, dispatched, and tested.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ddl_tpu.exceptions import DDLError
+from ddl_tpu.types import Topology
+
+#: Permutation search bound (reference ``shuffle.py:74-79`` used 1000 and
+#: SystemExit; we raise a typed error instead).
+_MAX_TRIES = 1000
+
+#: Valid exchange strategies (reference anticipated plumbing for more,
+#: ``datapusher.py:96-106``).
+EXCHANGE_METHODS = ("sendrecv_replace", "all_to_all")
+
+
+def exchange_permutation(n: int, seed: int, round_: int) -> np.ndarray:
+    """The shared partner permutation for one exchange round.
+
+    Every same-index producer across instances calls this with identical
+    arguments and gets the identical permutation — the decentralised
+    agreement trick of reference ``shuffle.py:28-48``.
+
+    Properties (validated): ``p[i] != i`` (no self-sends) and, for n > 2,
+    ``p[p[i]] != i`` (no 2-cycles — a 2-cycle would swap the same rows
+    straight back on the reverse lane).  n == 2 returns the swap; n == 1
+    the identity (no exchange possible).
+    """
+    if n <= 1:
+        return np.arange(n)
+    if n == 2:
+        return np.array([1, 0])
+    rng = np.random.default_rng([seed & 0x7FFFFFFF, round_ & 0x7FFFFFFF])
+    for _ in range(_MAX_TRIES):
+        p = rng.permutation(n)
+        if np.any(p == np.arange(n)):
+            continue
+        if np.any(p[p] == np.arange(n)):
+            continue
+        return p
+    raise DDLError(
+        f"no valid exchange permutation found for n={n} after {_MAX_TRIES} tries"
+    )
+
+
+def inverse_permutation(p: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(p)
+    inv[p] = np.arange(len(p))
+    return inv
+
+
+def exchange_slices(num_exchange: int) -> Tuple[slice, slice]:
+    """The two row lanes of one exchange round.
+
+    Lane A (rows ``[0, half)``) travels *forward* along the permutation;
+    lane B (rows ``[half, 2*half)``) travels *backward* — the reference's
+    two ``Sendrecv_replace`` calls with swapped dest/source
+    (``shuffle.py:95-108``).
+    """
+    half = num_exchange // 2
+    return slice(0, half), slice(half, 2 * half)
+
+
+class _Rendezvous:
+    """In-process exchange fabric: one board per producer-index, shared by
+    all simulated instances.  Thread-safe; used by ThreadExchangeShuffler."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._boxes: Dict[Tuple[int, int, int], np.ndarray] = {}
+
+    def put(self, key: Tuple[int, int, int], rows: np.ndarray) -> None:
+        with self._lock:
+            self._boxes[key] = rows
+            self._lock.notify_all()
+
+    def take(self, key: Tuple[int, int, int], timeout_s: float = 60.0) -> np.ndarray:
+        with self._lock:
+            if not self._lock.wait_for(
+                lambda: key in self._boxes, timeout=timeout_s
+            ):
+                raise DDLError(f"exchange rendezvous timed out waiting for {key}")
+            return self._boxes.pop(key)
+
+
+_default_rendezvous = _Rendezvous()
+
+
+class ThreadExchangeShuffler:
+    """Producer callback performing the cross-instance exchange in-process.
+
+    Registered by ``DataPusher`` when ``n_instances > 1`` and the consumer
+    requested a nonzero exchange fraction (reference ``datapusher.py:89-108``)
+    — and, with the fixed dispatcher, it actually runs each iteration.
+    """
+
+    def __init__(
+        self,
+        topology: Topology,
+        producer_idx: int,
+        num_exchange: int,
+        exchange_method: str = "sendrecv_replace",
+        rendezvous: Optional[_Rendezvous] = None,
+        seed: int = 0,
+    ):
+        if exchange_method not in EXCHANGE_METHODS:
+            raise NotImplementedError(
+                f"exchange_method {exchange_method!r}; valid: {EXCHANGE_METHODS}"
+            )
+        self.topology = topology
+        self.producer_idx = producer_idx
+        self.num_exchange = num_exchange
+        self.exchange_method = exchange_method
+        self.seed = seed
+        self._rdv = rendezvous or _default_rendezvous
+        self._round = 0
+
+    def global_shuffle(self, my_ary: np.ndarray, **kwargs: Any) -> None:
+        n = self.topology.n_instances
+        me = self.topology.instance_idx
+        if n <= 1 or self.num_exchange < 2:
+            return
+        p = exchange_permutation(n, self.seed + self.producer_idx, self._round)
+        pinv = inverse_permutation(p)
+        lane_a, lane_b = exchange_slices(self.num_exchange)
+        tag = self._round * 2
+        # Lane A forward: i -> p[i]; lane B backward: i -> pinv[i].
+        for lane, dest, src, t in (
+            (lane_a, int(p[me]), int(pinv[me]), tag),
+            (lane_b, int(pinv[me]), int(p[me]), tag + 1),
+        ):
+            self._rdv.put((self.producer_idx, t, dest), my_ary[lane].copy())
+            my_ary[lane] = self._rdv.take((self.producer_idx, t, me))
+        self._round += 1
+
+    # Factory signature expected by DataPusher's shuffler_factory hook.
+    @classmethod
+    def factory(cls, rendezvous: Optional[_Rendezvous] = None, seed: int = 0):
+        def make(
+            topology: Topology,
+            producer_idx: int,
+            num_exchange: int,
+            exchange_method: str,
+        ) -> "ThreadExchangeShuffler":
+            return cls(
+                topology,
+                producer_idx,
+                num_exchange,
+                exchange_method,
+                rendezvous=rendezvous,
+                seed=seed,
+            )
+
+        return make

@@ -95,6 +95,18 @@ class PipeChannel(ControlChannel):
         self._conn.close()
 
 
+def _resolve_ring(reply: MetaData_Producer_To_Consumer) -> WindowRing:
+    """Resolve a handshake reply's ring_ref to a usable ring."""
+    ref = getattr(reply, "ring_ref", None)
+    if isinstance(ref, WindowRing):
+        return ref
+    if isinstance(ref, str):
+        from ddl_tpu.transport.shm_ring import open_shm_ring
+
+        return open_shm_ring(ref)
+    raise TransportError(f"producer {reply.producer_idx} sent no ring_ref")
+
+
 class ConsumerConnection:
     """Consumer endpoint: broadcasts metadata, collects replies, owns rings.
 
@@ -136,17 +148,7 @@ class ConsumerConnection:
 
     def attach_rings(self) -> List[WindowRing]:
         """Open every producer's ring (by name or by in-process reference)."""
-        from ddl_tpu.transport.shm_ring import open_shm_ring
-
-        self.rings = []
-        for r in self.replies:
-            ref = getattr(r, "ring_ref", None)
-            if isinstance(ref, WindowRing):
-                self.rings.append(ref)
-            elif isinstance(ref, str):
-                self.rings.append(open_shm_ring(ref))
-            else:
-                raise TransportError(f"producer {r.producer_idx} sent no ring_ref")
+        self.rings = [_resolve_ring(r) for r in self.replies]
         return self.rings
 
     def shutdown_operation(self) -> None:
@@ -160,16 +162,10 @@ class ConsumerConnection:
         """
         rings = self.rings
         if not rings and self.replies:
-            from ddl_tpu.transport.shm_ring import open_shm_ring
-
             rings = []
             for r in self.replies:
-                ref = getattr(r, "ring_ref", None)
                 try:
-                    if isinstance(ref, WindowRing):
-                        rings.append(ref)
-                    elif isinstance(ref, str):
-                        rings.append(open_shm_ring(ref))
+                    rings.append(_resolve_ring(r))
                 except Exception:  # pragma: no cover - best-effort wake
                     pass
         for ring in rings:

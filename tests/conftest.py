@@ -1,19 +1,27 @@
 """Test configuration: simulate an 8-device TPU mesh on CPU.
 
-Must run before any jax import — pytest imports conftest first, so setting
-the env here covers every test module.  Mirrors SURVEY §8.1's test strategy:
-multi-chip behaviour is validated on a virtual CPU mesh
-(``--xla_force_host_platform_device_count``), the real chip is bench-only.
+Mirrors SURVEY §8.1's test strategy: multi-chip behaviour is validated on a
+virtual CPU mesh (``--xla_force_host_platform_device_count``); the real
+chip is bench-only.
+
+This environment ships an `axon` PJRT plugin whose sitecustomize overrides
+``JAX_PLATFORMS`` at interpreter start, so env vars alone do NOT select the
+CPU backend — ``jax.config.update("jax_platforms", "cpu")`` before the
+first backend initialization is required (and sufficient, as long as no
+test touched devices before conftest import, which pytest guarantees).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -22,3 +30,11 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    assert len(jax.devices()) == 8, (
+        f"expected 8 virtual CPU devices, got {jax.devices()}"
+    )
+    return jax.devices()

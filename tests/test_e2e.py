@@ -223,3 +223,37 @@ class TestProcessModeE2E:
         # Window content produced in a different PROCESS arrived intact.
         feats, tag = seen[0]
         assert np.all(tag[:, 0] == np.arange(16))
+
+
+class HeteroProducer(ProducerFunctionSkeleton):
+    """Different column geometry per producer (same batches_per_window)."""
+
+    def on_init(self, producer_idx=0, **kw):
+        width = 4 if producer_idx == 1 else 6
+        return DataProducerOnInitReturn(
+            nData=32, nValues=width, shape=(32, width),
+            splits=(width - 1, 1),
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = float(my_ary.shape[1])
+
+
+class TestHeterogeneousGeometry:
+    def test_per_producer_splits_served_correctly(self):
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                HeteroProducer(), batch_size=32, connection=env.connection,
+                n_epochs=2, output="numpy",
+            )
+            widths = []
+            for _ in range(2):
+                for feats, tag in loader:
+                    widths.append(feats.shape[1] + tag.shape[1])
+                    assert float(feats[0, 0]) == feats.shape[1] + 1
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return widths
+
+        assert main() == [4, 6]

@@ -81,3 +81,66 @@ class TestEarlyEpochEnd:
             return count
 
         assert main() == 4
+
+
+class TestGlobalArray:
+    def test_make_global_array_sharded(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ddl_tpu.ingest import make_global_array
+        from ddl_tpu.parallel import data_parallel_mesh
+
+        mesh = data_parallel_mesh()
+        sharding = NamedSharding(mesh, P("dp"))
+        batch = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+        g = make_global_array(batch, sharding)
+        assert g.shape == (16, 3)
+        assert len(g.addressable_shards) == len(jax.devices())
+        np.testing.assert_array_equal(np.asarray(g), batch)
+
+
+class TestLoaderShardedIngest:
+    def test_loader_jax_output_with_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ddl_tpu import (
+            DistributedDataLoader,
+            Marker,
+            distributed_dataloader,
+        )
+        from ddl_tpu.parallel import data_parallel_mesh
+
+        mesh = data_parallel_mesh()
+        sharding = NamedSharding(mesh, P("dp"))
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=32, connection=env.connection,
+                n_epochs=1, output="jax", sharding=sharding,
+            )
+            feats, tag = loader[0]
+            assert feats.sharding == sharding
+            assert len(feats.addressable_shards) == len(jax.devices())
+            loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+
+        main()
+
+
+class TestNorthStarReport:
+    def test_report_keys(self):
+        from ddl_tpu.ingest import north_star_report
+        from ddl_tpu.observability import Metrics
+
+        m = Metrics()
+        m.incr("consumer.samples", 100)
+        m.add_time("consumer.wait", 0.1)
+        r = north_star_report(m)
+        assert set(r) == {
+            "samples_per_sec", "stall_fraction", "ingest_bytes_per_sec",
+            "windows", "elapsed_s",
+        }
+        assert r["samples_per_sec"] > 0
