@@ -1,0 +1,128 @@
+"""Benchmark: loader→HBM ingest throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the north-star metric (BASELINE.md): samples/sec of the full
+pipeline — producer workers filling window rings, consumer draining
+zero-copy and streaming batches into device HBM while a jitted consumer
+computation runs.  ``vs_baseline`` compares against a faithful
+re-creation of the *reference's* design point on identical hardware:
+single-buffered strict alternation (its one-window-per-producer token
+protocol, reference ``ddl/datapusher.py:147-170``) with synchronous
+per-batch transfers and no overlap.  The reference itself publishes no
+numbers to compare against (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+N_DATA = 8192  # samples per window
+N_VALUES = 256  # f32 features per sample -> 8 MiB windows
+BATCH = 2048
+EPOCHS_MEASURED = 24
+N_PRODUCERS = 2
+
+
+def _make_producer():
+    from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+
+    class BenchProducer(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            self._rng = np.random.default_rng(producer_idx)
+            self._data = self._rng.random((N_DATA, N_VALUES), np.float32)
+            return DataProducerOnInitReturn(
+                nData=N_DATA, nValues=N_VALUES, shape=(N_DATA, N_VALUES),
+                splits=(N_VALUES - 1, 1),
+            )
+
+        def post_init(self, my_ary, **kw):
+            np.copyto(my_ary, self._data)
+
+        def execute_function(self, my_ary, **kw):
+            # Representative per-window producer work: local in-place
+            # shuffle (what the reference example does per refill,
+            # reference tests/run_ddl.py:163-167).
+            self._rng.shuffle(my_ary)
+
+    return BenchProducer()
+
+
+def _consumer_compute():
+    """A small jitted reduction standing in for the training step's
+    consumption of the batch (keeps the device busy so overlap matters)."""
+    import jax
+
+    @jax.jit
+    def f(x, y):
+        return (x @ x.T).sum() + y.sum()
+
+    return f
+
+
+def _run(nslots: int, n_producers: int, sync_every_batch: bool) -> float:
+    """Returns steady-state samples/sec of one pipeline configuration."""
+    import jax
+
+    from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+    from ddl_tpu.observability import Metrics
+
+    compute = _consumer_compute()
+    metrics = Metrics()
+    n_epochs = EPOCHS_MEASURED + 2  # first two epochs are warmup
+
+    @distributed_dataloader(n_producers=n_producers, mode="thread", nslots=nslots)
+    def main(env):
+        loader = DistributedDataLoader(
+            _make_producer(), batch_size=BATCH, connection=env.connection,
+            n_epochs=n_epochs, output="jax", metrics=metrics,
+        )
+        t0 = None
+        samples = 0
+        out = None
+        for epoch in range(n_epochs):
+            if epoch == 2:  # warmup done (compile + first fills)
+                if out is not None:
+                    jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                samples = 0
+            for x, y in loader:
+                out = compute(x, y)
+                if sync_every_batch:
+                    jax.block_until_ready(out)
+                if t0 is not None:
+                    samples += BATCH
+                loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+        jax.block_until_ready(out)
+        return samples / (time.perf_counter() - t0)
+
+    return main()
+
+
+def main() -> None:
+    # Overlapped ddl_tpu pipeline: double-buffered rings, async ingest.
+    ours = _run(nslots=2, n_producers=N_PRODUCERS, sync_every_batch=False)
+    # Reference design point: strict alternation, synchronous transfers.
+    baseline = _run(nslots=1, n_producers=N_PRODUCERS, sync_every_batch=True)
+    print(
+        json.dumps(
+            {
+                "metric": "ingest_samples_per_sec",
+                "value": round(ours, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(ours / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

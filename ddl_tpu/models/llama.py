@@ -1,0 +1,193 @@
+"""Llama-style decoder LM — the flagship model fed by the ddl_tpu loader.
+
+The reference framework carried no models (SURVEY §0: "no model code"); the
+driver's pod-scale config ("Llama-3-8B pretrain loop fed solely by the ddl
+TPU backend", BASELINE.json configs[4]) requires a real transformer training
+loop on the consumer side.  This is a TPU-first functional implementation:
+
+- pure init/apply functions over a params pytree (jit/grad/shard friendly,
+  no framework state),
+- bfloat16 activations by default (MXU-native), fp32 RMSNorm accumulations,
+- RoPE, grouped-query attention, SwiGLU — the Llama-3 block structure,
+- sequence parallelism via ring attention when the mesh has an ``sp`` axis,
+- parameter PartitionSpecs for fsdp/tp sharding (GSPMD inserts the
+  collectives).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    d_ff: int = 352
+    max_seq: int = 512
+    rope_theta: float = 500000.0  # Llama-3 base frequency
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        """The reference-scale config (BASELINE.json configs[4])."""
+        return LlamaConfig(
+            vocab=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq=8192,
+        )
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig()
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Initialise a params pytree (fp32 master weights)."""
+    keys = iter(jax.random.split(key, 4 + cfg.n_layers * 7))
+
+    def dense(k, fan_in, shape):
+        return jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+
+    d, hd = cfg.d_model, cfg.head_dim
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn_norm": jnp.ones((d,), jnp.float32),
+                "wq": dense(next(keys), d, (d, cfg.n_heads * hd)),
+                "wk": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
+                "wv": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
+                "wo": dense(next(keys), cfg.n_heads * hd, (cfg.n_heads * hd, d)),
+                "mlp_norm": jnp.ones((d,), jnp.float32),
+                "w_gate": dense(next(keys), d, (d, cfg.d_ff)),
+                "w_up": dense(next(keys), d, (d, cfg.d_ff)),
+                "w_down": dense(next(keys), cfg.d_ff, (cfg.d_ff, d)),
+            }
+        )
+    return {
+        "embed": dense(next(keys), d, (cfg.vocab, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(next(keys), d, (d, cfg.vocab)),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpecs mirroring init_params: fsdp shards the d_model-ish
+    axis, tp shards heads / ffn-hidden — the standard Megatron layout
+    realised declaratively (GSPMD inserts all-reduce/all-gather)."""
+    layer = {
+        "attn_norm": P(None),
+        "wq": P("fsdp", "tp"),
+        "wk": P("fsdp", "tp"),
+        "wv": P("fsdp", "tp"),
+        "wo": P("tp", "fsdp"),
+        "mlp_norm": P(None),
+        "w_gate": P("fsdp", "tp"),
+        "w_up": P("fsdp", "tp"),
+        "w_down": P("tp", "fsdp"),
+    }
+    return {
+        "embed": P(None, "fsdp"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def _rms_norm(x: jax.Array, gain: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * gain).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: (B, T, H, D), positions: (T,)."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (T, Dh)
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :d_half], x[..., d_half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Optional[Any] = None,
+) -> jax.Array:
+    """Next-token logits, (B, T, vocab).
+
+    With a mesh carrying an ``sp`` axis of size > 1, attention runs as
+    sequence-parallel ring attention (K/V rotating over ICI); otherwise
+    dense causal attention.  RoPE positions are global either way (the
+    token axis is only *sharded*, never re-indexed).
+    """
+    from ddl_tpu.parallel.ring_attention import attention_reference, ring_attention
+
+    B, T = tokens.shape
+    dt = cfg.dtype
+    positions = jnp.arange(T)
+    x = params["embed"].astype(dt)[tokens]  # (B, T, D)
+
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        # GQA k/v stay compact: expansion happens inside the attention
+        # block, so ring attention rotates 1/rep of the bytes over ICI.
+        rep = cfg.n_heads // cfg.n_kv_heads
+        if mesh is not None:
+            attn = ring_attention(q, k, v, mesh, causal=True, kv_repeat=rep)
+        else:
+            attn = attention_reference(q, k, v, causal=True, kv_repeat=rep)
+        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+
+        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def next_token_loss(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Optional[Any] = None,
+) -> jax.Array:
+    """Mean cross-entropy of next-token prediction over (B, T) tokens.
+
+    Targets are ``roll(tokens, -1)`` with the final position masked rather
+    than a ``[:-1]`` slice — the sequence axis keeps its full length, so it
+    stays evenly shardable over ``sp``.
+    """
+    B, T = tokens.shape
+    logits = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (jnp.arange(T) < T - 1).astype(ll.dtype)[None, :]
+    return -jnp.sum(ll * mask) / (B * (T - 1))

@@ -1,0 +1,75 @@
+"""Pointwise MLP regressor — the reference harness's model, made real.
+
+The reference's example config carried vestigial ``ShapeNet``/
+``ParameterNet`` MLP sections that nothing consumed (reference
+``tests/run_ddl.py:269-298``, SURVEY §5.6); its "training" loop only
+drained batches.  This model closes that loop: a CFD-style pointwise
+regressor consuming the (pos, target, weight) column tuple the example
+producer emits (reference ``tests/run_ddl.py:156-159``), trained per-point
+— the workload the reference's data pipeline was built to feed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class PointNetConfig:
+    n_inputs: int = 3  # point position columns
+    n_outputs: int = 6  # field value columns
+    hidden: Tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+def init_params(cfg: PointNetConfig, key: jax.Array) -> Params:
+    sizes = (cfg.n_inputs, *cfg.hidden, cfg.n_outputs)
+    keys = jax.random.split(key, len(sizes) - 1)
+    layers: List[Dict[str, jax.Array]] = []
+    for k, fan_in, fan_out in zip(keys, sizes[:-1], sizes[1:]):
+        layers.append(
+            {
+                "w": jax.random.normal(k, (fan_in, fan_out), jnp.float32)
+                / jnp.sqrt(fan_in),
+                "b": jnp.zeros((fan_out,), jnp.float32),
+            }
+        )
+    return {"layers": layers}
+
+
+def param_specs(cfg: PointNetConfig) -> Params:
+    """Replicated params — the model is tiny; dp handles the scale."""
+    return {
+        "layers": [
+            {"w": P(None, None), "b": P(None)} for _ in range(len(cfg.hidden) + 1)
+        ]
+    }
+
+
+def forward(params: Params, x: jax.Array, cfg: PointNetConfig) -> jax.Array:
+    h = x.astype(cfg.dtype)
+    layers = params["layers"]
+    for layer in layers[:-1]:
+        h = jax.nn.gelu(h @ layer["w"] + layer["b"])
+    out = h @ layers[-1]["w"] + layers[-1]["b"]
+    return out
+
+
+def weighted_mse_loss(
+    params: Params,
+    batch: Tuple[jax.Array, jax.Array, jax.Array],
+    cfg: PointNetConfig,
+) -> jax.Array:
+    """Weighted MSE over (pos, target, weight) — the example producer's
+    column tuple."""
+    pos, target, weight = batch
+    pred = forward(params, pos, cfg)
+    err = (pred - target.astype(pred.dtype)) ** 2
+    return jnp.mean(err * weight.astype(pred.dtype))

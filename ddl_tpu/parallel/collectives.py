@@ -34,7 +34,7 @@ def _build_sendrecv_step(
 ):
     """Jitted window-shuffle step for one permutation (cached per perm)."""
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = mesh_key.mesh
@@ -55,7 +55,7 @@ def _build_sendrecv_step(
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(axis),
-        check_rep=False,
+        check_vma=False,
     )
     spec = NamedSharding(mesh, P(axis))
     return jax.jit(fn, in_shardings=spec, out_shardings=spec)
@@ -66,7 +66,7 @@ def _build_all_to_all_step(mesh_key: Any, axis: str, num_exchange: int):
     """All-to-all strategy: every instance scatters its exchange block
     uniformly to all instances and gathers one sub-block from each."""
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = mesh_key.mesh
@@ -84,7 +84,7 @@ def _build_all_to_all_step(mesh_key: Any, axis: str, num_exchange: int):
 
     fn = shard_map(
         shard_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
-        check_rep=False,
+        check_vma=False,
     )
     spec = NamedSharding(mesh, P(axis))
     return jax.jit(fn, in_shardings=spec, out_shardings=spec)

@@ -1,0 +1,162 @@
+"""Ring attention: sequence-parallel attention over the ``sp`` mesh axis.
+
+Long-context support is first-class in ddl_tpu (the reference had no
+attention at all — SURVEY §5.7 notes its only ring was the data-plane
+``Sendrecv_replace`` exchange).  The design follows the public ring
+attention recipe (Liu et al., blockwise attention with online softmax):
+
+- The sequence is sharded across ``sp``: each device holds Q/K/V for its
+  local block of tokens.
+- K/V blocks rotate around the ring with ``lax.ppermute`` (one ICI hop per
+  step) while each device accumulates its queries' attention over every
+  block with a numerically stable running max / denominator — so the full
+  T×T score matrix never materialises and memory stays O(T_local²).
+- Causal masking uses global token positions, so the result is bit-for-bit
+  the same attention as the single-device computation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal: bool, scale: float,
+                  kv_repeat: int = 1):
+    """Scores and weighted values of one (Q-block, KV-block) pair.
+
+    Returns (o_partial, row_max, row_sum) for online-softmax accumulation.
+    q: (B, Tq, H, D); k/v: (B, Tk, H/kv_repeat, D); positions: (Tq,), (Tk,).
+    GQA heads are expanded here, locally — the ring rotates the compact
+    K/V, so ICI traffic stays 1/kv_repeat of the naive pre-expanded form.
+    """
+    if kv_repeat > 1:
+        k = jnp.repeat(k, kv_repeat, axis=2)
+        v = jnp.repeat(v, kv_repeat, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
+        s = jnp.where(mask, _NEG_INF, s)
+    m = jnp.max(s, axis=-1)  # (B, H, Tq); _NEG_INF for fully masked rows
+    # Subtract a zeroed max for fully masked rows so exp() sees finite
+    # arguments, and zero their probabilities — but RETURN the true max:
+    # clamping the running max to 0 would underflow exp(s) later for rows
+    # whose real scores are strongly negative.
+    safe_m = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, jnp.sum(p, axis=-1)
+
+
+def ring_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    kv_repeat: int = 1,
+) -> jax.Array:
+    """Per-shard ring attention body (call under ``shard_map``).
+
+    Args are this device's sequence block: q (B, T_local, H, D) and
+    compact GQA k/v (B, T_local, H/kv_repeat, D).  The compact K/V blocks
+    circulate ``sp`` times (GQA expansion happens locally per block, so
+    ring ICI traffic is 1/kv_repeat of the expanded size); accumulation is
+    the flash-attention online softmax generalised across ring steps.
+    """
+    sp = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = 1.0 / (D**0.5)
+    q_pos = my_idx * T + jnp.arange(T)
+
+    def step(carry, i):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        # Block arriving at ring step i originated at (my_idx - i) mod sp.
+        src = (my_idx - i) % sp
+        k_pos = src * T + jnp.arange(T)
+        o_blk, m_blk, l_blk = _block_attend(
+            q, k_cur, v_cur, q_pos, k_pos, causal, scale, kv_repeat
+        )
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)  # rescale old accumulator
+        beta = jnp.exp(m_blk - m_new)  # rescale new block
+        l_new = l_acc * alpha + l_blk * beta
+        o_new = (
+            o_acc * alpha.transpose(0, 2, 1)[..., None]
+            + o_blk * beta.transpose(0, 2, 1)[..., None]
+        )
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((B, H, T), _NEG_INF, dtype=q.dtype)
+    l0 = jnp.zeros((B, H, T), dtype=q.dtype)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(sp)
+    )
+    l = jnp.maximum(l, 1e-30)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "kv_repeat"))
+def attention_reference(q, k, v, causal: bool = True, kv_repeat: int = 1):
+    """Single-device full attention — the correctness oracle for tests."""
+    if kv_repeat > 1:
+        k = jnp.repeat(k, kv_repeat, axis=2)
+        v = jnp.repeat(v, kv_repeat, axis=2)
+    B, T, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D**0.5)
+    if causal:
+        mask = jnp.arange(T)[None, :] > jnp.arange(T)[:, None]
+        s = jnp.where(mask[None, None], _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Any,
+    causal: bool = True,
+    axis: str = "sp",
+    dp_axis: Optional[str] = "dp",
+    kv_repeat: int = 1,
+) -> jax.Array:
+    """Sequence-parallel attention over global arrays.
+
+    q: (B, T, H, D), k/v: (B, T, H/kv_repeat, D) logically global; B
+    sharded over ``dp_axis`` (if present in the mesh), T sharded over
+    ``axis``.  Falls back to the dense reference when the mesh has no
+    ``axis`` or it has size 1.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat)
+    batch_axis = dp_axis if (dp_axis and dp_axis in mesh.axis_names) else None
+    spec = P(batch_axis, axis, None, None)
+    fn = shard_map(
+        functools.partial(
+            ring_attention_shard,
+            axis_name=axis,
+            causal=causal,
+            kv_repeat=kv_repeat,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,107 @@
+"""Sharded training steps: the consumer-side compute fed by the loader.
+
+The reference delegated gradient data-parallelism to user-initialised
+``torch.distributed`` DDP outside the library (reference
+``tests/run_ddl.py:199-200``, SURVEY §2.3); the TPU-native equivalent is a
+jitted train step with NamedSharding annotations — GSPMD inserts the psum
+for dp-replicated gradients, the all-gathers for fsdp-sharded params, and
+the tp collectives, all riding ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _named(mesh: Any, spec_tree: Any) -> Any:
+    """Map a PartitionSpec pytree to NamedShardings, dropping axes the mesh
+    doesn't have (so one spec tree serves dp-only and dp×fsdp×tp meshes)."""
+
+    def fix(spec: P) -> NamedSharding:
+        parts = []
+        for entry in spec:
+            if entry is None:
+                parts.append(None)
+            elif isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in mesh.axis_names)
+                parts.append(kept if kept else None)
+            else:
+                parts.append(entry if entry in mesh.axis_names else None)
+        return NamedSharding(mesh, P(*parts))
+
+    return jax.tree.map(
+        fix, spec_tree, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+def make_train_step(
+    loss_fn: Callable[..., jax.Array],
+    optimizer: Any,
+    mesh: Any,
+    param_spec_tree: Any,
+    batch_spec: P = P(("dp",)),
+    donate: bool = True,
+) -> Tuple[Callable[..., Any], Callable[..., TrainState]]:
+    """Build (init_fn, step_fn) for a sharded training loop.
+
+    - ``loss_fn(params, batch) -> scalar`` — pure; model/config closed over.
+    - ``optimizer`` — an optax GradientTransformation.
+    - ``param_spec_tree`` — PartitionSpecs matching the params pytree
+      (axes absent from ``mesh`` are dropped, see :func:`_named`).
+    - ``batch_spec`` — sharding of each batch leaf (default: dp over the
+      leading axis; pass ``P(("dp",), "sp")`` for sequence-parallel token
+      batches).
+
+    GSPMD derives every collective from these annotations; there is no
+    hand-written psum anywhere.
+    """
+    param_sh = _named(mesh, param_spec_tree)
+    batch_sh = _named(mesh, batch_spec)
+
+    def init_fn(params: Any) -> TrainState:
+        # Jitted identity, NOT device_put: device_put aliases buffers that
+        # already live on a target device (e.g. replicated specs), and the
+        # donated train step would then delete the caller's input tree.
+        # A compiled copy guarantees fresh buffers the step may donate.
+        params = jax.jit(lambda t: t, out_shardings=param_sh)(params)
+        # optax states are built leaf-wise from params (zeros_like etc.), so
+        # moments inherit the param shardings — fsdp shards the optimizer
+        # state for free (the ZeRO property).
+        opt_state = optimizer.init(params)
+        return TrainState(params=params, opt_state=opt_state, step=0)
+
+    donate_argnums = (0, 1) if donate else ()
+
+    @functools.partial(jax.jit, donate_argnums=donate_argnums)
+    def _step(params: Any, opt_state: Any, batch: Any):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    def step_fn(state: TrainState, batch: Any) -> Tuple[TrainState, jax.Array]:
+        # device_put reshards device-resident arrays on-device and uploads
+        # host arrays — no host round trip in either case.
+        batch = jax.tree.map(
+            lambda b: b
+            if isinstance(b, jax.Array) and b.sharding == batch_sh
+            else jax.device_put(b, batch_sh),
+            batch,
+        )
+        params, opt_state, loss = _step(state.params, state.opt_state, batch)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_fn, step_fn

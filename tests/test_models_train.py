@@ -1,0 +1,182 @@
+"""Model + sharded train-step tests on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl_tpu.models import llama, pointnet
+from ddl_tpu.parallel.mesh import make_mesh
+from ddl_tpu.parallel.train import make_train_step
+from jax.sharding import PartitionSpec as P
+
+
+class TestLlamaModel:
+    def test_forward_shapes_and_finite(self):
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab)
+        logits = llama.forward(params, tokens, cfg)
+        assert logits.shape == (2, 16, cfg.vocab)
+        assert logits.dtype == jnp.float32
+        assert bool(jnp.all(jnp.isfinite(logits)))
+
+    def test_causality(self):
+        """Changing a future token must not change earlier logits."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        t1 = jnp.zeros((1, 8), jnp.int32)
+        t2 = t1.at[0, 7].set(5)
+        l1 = llama.forward(params, t1, cfg)
+        l2 = llama.forward(params, t2, cfg)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, :7]), np.asarray(l2[0, :7]), rtol=1e-5
+        )
+
+    def test_loss_decreases_under_training(self):
+        cfg = llama.LlamaConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+            d_ff=64, dtype=jnp.float32,
+        )
+        params = llama.init_params(cfg, jax.random.key(0))
+        mesh = make_mesh({"dp": 8})
+        opt = optax.adam(1e-2)
+        init_fn, step_fn = make_train_step(
+            lambda p, b: llama.next_token_loss(p, b, cfg),
+            opt, mesh, llama.param_specs(cfg), batch_spec=P(("dp",)),
+        )
+        state = init_fn(params)
+        tokens = np.tile(np.arange(16, dtype=np.int32) % 7, (8, 1))
+        losses = []
+        for _ in range(20):
+            state, loss = step_fn(state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_sp_forward_matches_dense(self):
+        """Ring-attention (sp) forward == dense forward."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        dense = llama.forward(params, tokens, cfg, mesh=None)
+        sp = llama.forward(params, tokens, cfg, mesh=mesh)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(sp), rtol=2e-4, atol=2e-4
+        )
+
+
+class TestShardedTrainStep:
+    @pytest.mark.parametrize(
+        "axes,batch_spec",
+        [
+            ({"dp": 8}, P(("dp",))),
+            ({"dp": 2, "fsdp": 2, "tp": 2}, P(("dp",))),
+            ({"dp": 2, "sp": 4}, P("dp", "sp")),
+            ({"dp": 2, "fsdp": 2, "sp": 2}, P("dp", "sp")),
+        ],
+    )
+    def test_llama_step_on_mesh(self, axes, batch_spec):
+        cfg = llama.LlamaConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=64, dtype=jnp.float32,
+        )
+        mesh = make_mesh(dict(axes))
+        params = llama.init_params(cfg, jax.random.key(0))
+        init_fn, step_fn = make_train_step(
+            lambda p, b: llama.next_token_loss(p, b, cfg, mesh=mesh),
+            optax.adamw(1e-3), mesh, llama.param_specs(cfg),
+            batch_spec=batch_spec,
+        )
+        state = init_fn(params)
+        tokens = np.random.default_rng(0).integers(
+            0, 64, (8, 16), dtype=np.int32
+        )
+        state, loss = step_fn(state, tokens)
+        state, loss2 = step_fn(state, tokens)
+        assert np.isfinite(float(loss)) and np.isfinite(float(loss2))
+        assert float(loss2) < float(loss)  # it learns the repeated batch
+        assert state.step == 2
+
+    def test_param_shardings_respected(self):
+        cfg = llama.LlamaConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=64, dtype=jnp.float32,
+        )
+        mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        params = llama.init_params(cfg, jax.random.key(0))
+        init_fn, _ = make_train_step(
+            lambda p, b: llama.next_token_loss(p, b, cfg),
+            optax.adam(1e-3), mesh, llama.param_specs(cfg),
+        )
+        state = init_fn(params)
+        wq = state.params["layers"][0]["wq"]
+        assert wq.sharding.spec == P("fsdp", "tp")
+        # fsdp shards the optimizer moments too (ZeRO property).
+        mu_wq = state.opt_state[0].mu["layers"][0]["wq"]
+        assert mu_wq.sharding.spec == P("fsdp", "tp")
+
+
+class TestPointNet:
+    def test_train_on_loader_batches(self):
+        """Close the reference's loop: pointwise model trained from the
+        actual DistributedDataLoader output tuple."""
+        from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+        import sys, os
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+        )
+        from run_ddl import DataProducer, Params
+
+        cfg = pointnet.PointNetConfig(n_inputs=3, n_outputs=6)
+        mesh = make_mesh({"dp": 8})
+        init_fn, step_fn = make_train_step(
+            lambda p, b: pointnet.weighted_mse_loss(p, b, cfg),
+            optax.adam(1e-2), mesh, pointnet.param_specs(cfg),
+        )
+        state = init_fn(pointnet.init_params(cfg, jax.random.key(0)))
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(params, env):
+            nonlocal state
+            loader = DistributedDataLoader(
+                DataProducer(params), batch_size=64,
+                connection=env.connection, n_epochs=2, output="numpy",
+            )
+            losses = []
+            for _ in range(2):
+                for batch in loader:
+                    state, loss = step_fn(state, batch)
+                    losses.append(float(loss))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return losses
+
+        losses = main(Params(n_data=256, batch_size=64))
+        assert len(losses) == 2 * (256 // 64)
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(l) for l in losses)
+
+
+class TestInitFnDonationSafety:
+    def test_same_host_params_reusable_across_train_steps(self):
+        """Regression: init_fn must copy (not alias) so the donated step
+        cannot delete the caller's params tree (bit dryrun n=2/6)."""
+        cfg = llama.LlamaConfig(
+            vocab=32, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+            d_ff=32, dtype=jnp.float32,
+        )
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = np.zeros((2, 8), np.int32)
+        for axes in ({"dp": 2}, {"sp": 2}):
+            mesh = make_mesh(axes, jax.devices()[:2])
+            init_fn, step_fn = make_train_step(
+                lambda p, b, _m=mesh: llama.next_token_loss(p, b, cfg, mesh=_m),
+                optax.adam(1e-3), mesh, llama.param_specs(cfg),
+                batch_spec=P("dp", "sp") if "sp" in axes else P(("dp",)),
+            )
+            state = init_fn(params)  # same host tree every plan
+            _, loss = step_fn(state, tokens)
+            assert np.isfinite(float(loss))
