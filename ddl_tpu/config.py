@@ -1,0 +1,82 @@
+"""Run configuration: one typed dataclass, JSON- and env-overridable.
+
+The reference had no config system — every knob was a constructor argument
+plus SLURM env sniffing, and its harness rolled six ad-hoc nested
+dataclasses (reference ``tests/run_ddl.py:243-298``, SURVEY §5.6).  This is
+the librarified version: defaults → JSON file → ``DDL_TPU_*`` env vars →
+explicit kwargs, later layers winning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+from ddl_tpu.types import RunMode
+
+
+@dataclasses.dataclass
+class LoaderConfig:
+    """Everything the pipeline needs, in one place."""
+
+    # topology
+    mode: str = RunMode.THREAD.value
+    n_producers: int = 2
+    nslots: int = 2
+    # batch geometry
+    batch_size: int = 32
+    n_epochs: int = 1
+    # global shuffle
+    global_shuffle_fraction_exchange: float = 0.0
+    exchange_method: str = "sendrecv_replace"
+    shuffle_seed: int = 0
+    # consumer output
+    output: str = "torch"
+    # failure detection
+    ring_timeout_s: float = 300.0
+    stall_budget_s: float = 120.0
+    # checkpointing
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_epochs: int = 0  # 0 = disabled
+
+    _ENV_PREFIX = "DDL_TPU_"
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, **overrides: Any) -> "LoaderConfig":
+        """defaults → JSON file → env (`DDL_TPU_<FIELD>`) → kwargs."""
+        values: dict = {}
+        if path:
+            with open(path) as f:
+                loaded = json.load(f)
+            unknown = set(loaded) - {f.name for f in dataclasses.fields(cls)}
+            if unknown:
+                raise ValueError(f"unknown config keys in {path}: {sorted(unknown)}")
+            values.update(loaded)
+        for field in dataclasses.fields(cls):
+            if field.name.startswith("_"):
+                continue
+            env = os.environ.get(cls._ENV_PREFIX + field.name.upper())
+            if env is not None:
+                values[field.name] = _coerce(env, field.type)
+        values.update(overrides)
+        return cls(**values)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+
+    def run_mode(self) -> RunMode:
+        return RunMode(self.mode)
+
+
+def _coerce(raw: str, annot: Any) -> Any:
+    annot = str(annot)
+    if "int" in annot:
+        return int(raw)
+    if "float" in annot:
+        return float(raw)
+    if "bool" in annot:
+        return raw.lower() in ("1", "true", "yes")
+    return raw

@@ -211,6 +211,10 @@ def distributed_dataloader(
     ``(mpi_env, connection)`` to the user function's args; here a single
     :class:`DDL_Env` (topology + consumer connection) is appended.
     Returns ``func``'s return value after all producers have exited.
+
+    PROCESS/MULTIHOST modes use ``multiprocessing`` spawn: call the
+    decorated main under ``if __name__ == "__main__":`` (standard spawn
+    requirement), or the re-imported script will recursively spawn.
     """
 
     def deco(f: Callable[..., Any]) -> Callable[..., Any]:

@@ -79,17 +79,31 @@ def make_train_step(
         params = jax.jit(lambda t: t, out_shardings=param_sh)(params)
         # optax states are built leaf-wise from params (zeros_like etc.), so
         # moments inherit the param shardings — fsdp shards the optimizer
-        # state for free (the ZeRO property).
+        # state for free (the ZeRO property).  Leaves NOT derived from
+        # params (adam's scalar step count) come out pinned to one device;
+        # reshard those to mesh-replicated so the whole state lives on one
+        # device set (mixed sets break jit after checkpoint restore).
         opt_state = optimizer.init(params)
+        replicated = NamedSharding(mesh, P())
+
+        def on_mesh(x: Any) -> Any:
+            sh = getattr(x, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+                return x
+            return jax.device_put(x, replicated)
+
+        opt_state = jax.tree.map(on_mesh, opt_state)
         return TrainState(params=params, opt_state=opt_state, step=0)
 
     donate_argnums = (0, 1) if donate else ()
 
     @functools.partial(jax.jit, donate_argnums=donate_argnums)
     def _step(params: Any, opt_state: Any, batch: Any):
+        import optax
+
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     def step_fn(state: TrainState, batch: Any) -> Tuple[TrainState, jax.Array]:

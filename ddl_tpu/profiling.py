@@ -1,0 +1,48 @@
+"""Profiling integration: jax.profiler traces around pipeline sections.
+
+The reference's only introspection was the DEBUG call tracer
+(``with_logging``, SURVEY §5.1), kept in ``ddl_tpu.utils``.  This adds the
+TPU-native layer: ``jax.profiler`` device traces with named host
+annotations, so ingest stalls and collective time show up on the TensorBoard
+timeline next to the XLA ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host span, visible on the profiler timeline.
+
+    Usage::
+
+        with annotate("ddl.window_drain"):
+            batch = loader[i]
+    """
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Trace only when a log dir is configured (no-op otherwise)."""
+    if log_dir:
+        with trace(log_dir):
+            yield
+    else:
+        yield

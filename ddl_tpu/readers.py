@@ -1,0 +1,178 @@
+"""Producer-function library: ready-made readers for common data layouts.
+
+The reference shipped only the abstract skeleton — every user wrote their
+own producer (reference ``ddl/datasetwrapper.py``).  These cover the
+driver's scale-out configs (BASELINE.json): in-memory arrays (the
+``TensorDataset`` analog, configs[0]), sharded files on disk
+(ImageNet/WebDataset-style shard-per-producer, configs[1-2]), and token
+streams for LLM pretraining (C4/Llama feed, configs[3-4]).  All shard
+deterministically by ``(instance_idx, producer_idx)`` the way the
+reference example sliced per instance (reference ``tests/run_ddl.py:84-87``).
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ddl_tpu.datasetwrapper import DataProducerOnInitReturn, ProducerFunctionSkeleton
+
+
+def _my_shard(n_items: int, producer_idx: int, n_producers: int,
+              instance_idx: int, n_instances: int) -> np.ndarray:
+    """Deterministic strided shard of [0, n_items) for this worker."""
+    worker = instance_idx * n_producers + (producer_idx - 1)
+    total = n_instances * n_producers
+    return np.arange(worker % total, n_items, total)
+
+
+class ArrayProducer(ProducerFunctionSkeleton):
+    """Serve a host-resident (N, F) array — the ``TensorDataset`` analog.
+
+    Each worker owns a strided shard; every window is a fresh sample of
+    ``window_size`` rows from the shard (with reshuffle per refill).
+    """
+
+    def __init__(self, data: np.ndarray, window_size: int,
+                 splits: Optional[Sequence[int]] = None, seed: int = 0):
+        self.data = np.ascontiguousarray(data)
+        self.window_size = window_size
+        self.splits = tuple(splits) if splits else (data.shape[1],)
+        self.seed = seed
+
+    def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
+                n_instances=1, **kw) -> DataProducerOnInitReturn:
+        idx = _my_shard(len(self.data), producer_idx, n_producers,
+                        instance_idx, n_instances)
+        self._shard = self.data[idx]
+        if len(self._shard) < self.window_size:
+            reps = -(-self.window_size // max(len(self._shard), 1))
+            self._shard = np.tile(self._shard, (reps, 1))
+        self._rng = np.random.default_rng(
+            [self.seed, instance_idx, producer_idx]
+        )
+        return DataProducerOnInitReturn(
+            nData=self.window_size,
+            nValues=self.data.shape[1],
+            shape=(self.window_size, self.data.shape[1]),
+            splits=self.splits,
+            dtype=self.data.dtype,
+        )
+
+    def _fill(self, my_ary: np.ndarray) -> None:
+        pick = self._rng.choice(len(self._shard), self.window_size,
+                                replace=False)
+        np.copyto(my_ary, self._shard[pick])
+
+    def post_init(self, my_ary, **kw):
+        self._fill(my_ary)
+
+    def execute_function(self, my_ary, **kw):
+        self._fill(my_ary)
+
+
+class FileShardProducer(ProducerFunctionSkeleton):
+    """Stream ``.npy`` shard files matching a glob, shard-per-worker.
+
+    The layout of WebDataset/ImageNet-style shard collections: many
+    same-shaped record files; each worker round-robins its own subset,
+    loading one shard per window refill (IO overlaps training via the
+    ring's double buffering).
+    """
+
+    def __init__(self, pattern: str, splits: Optional[Sequence[int]] = None,
+                 seed: int = 0):
+        self.pattern = pattern
+        self.splits = tuple(splits) if splits else None
+        self.seed = seed
+
+    def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
+                n_instances=1, **kw) -> DataProducerOnInitReturn:
+        paths = sorted(glob_mod.glob(self.pattern))
+        if not paths:
+            raise FileNotFoundError(f"no shards match {self.pattern!r}")
+        mine = _my_shard(len(paths), producer_idx, n_producers,
+                         instance_idx, n_instances)
+        if len(mine) == 0:
+            raise ValueError(
+                f"{len(paths)} shards < {n_instances * n_producers} workers"
+            )
+        self._paths = [paths[i] for i in mine]
+        self._cursor = 0
+        self._rng = np.random.default_rng([self.seed, producer_idx])
+        first = np.load(self._paths[0])
+        self._shape = first.shape
+        self._dtype = first.dtype
+        return DataProducerOnInitReturn(
+            nData=first.shape[0],
+            nValues=int(np.prod(first.shape[1:])),
+            shape=(first.shape[0], int(np.prod(first.shape[1:]))),
+            splits=self.splits or (int(np.prod(first.shape[1:])),),
+            dtype=first.dtype,
+        )
+
+    def _load_next(self, my_ary: np.ndarray) -> None:
+        path = self._paths[self._cursor % len(self._paths)]
+        self._cursor += 1
+        arr = np.load(path).reshape(my_ary.shape)
+        self._rng.shuffle(arr)
+        np.copyto(my_ary, arr)
+
+    def post_init(self, my_ary, **kw):
+        self._load_next(my_ary)
+
+    def execute_function(self, my_ary, **kw):
+        self._load_next(my_ary)
+
+
+class TokenStreamProducer(ProducerFunctionSkeleton):
+    """Serve fixed-length token sequences from a flat token array on disk.
+
+    The C4/pretrain feed shape (BASELINE configs[3-4]): a memory-mapped
+    1-D token file; each window is ``windows_rows`` sequences of
+    ``seq_len`` tokens drawn from this worker's strided region.  Output
+    splits are ``(seq_len,)`` — the consumer reshapes into (B, T) int
+    batches for the LM loss.
+    """
+
+    def __init__(self, token_file: str, seq_len: int, window_rows: int,
+                 dtype: Any = np.int32, seed: int = 0):
+        self.token_file = token_file
+        self.seq_len = seq_len
+        self.window_rows = window_rows
+        self.dtype = np.dtype(dtype)
+        self.seed = seed
+
+    def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
+                n_instances=1, **kw) -> DataProducerOnInitReturn:
+        self._tokens = np.memmap(self.token_file, dtype=self.dtype, mode="r")
+        n_seqs = len(self._tokens) // self.seq_len
+        mine = _my_shard(n_seqs, producer_idx, n_producers,
+                         instance_idx, n_instances)
+        if len(mine) == 0:
+            raise ValueError("token file smaller than one sequence per worker")
+        self._mine = mine
+        self._rng = np.random.default_rng([self.seed, instance_idx, producer_idx])
+        return DataProducerOnInitReturn(
+            nData=self.window_rows,
+            nValues=self.seq_len,
+            shape=(self.window_rows, self.seq_len),
+            splits=(self.seq_len,),
+            dtype=self.dtype,
+        )
+
+    def _fill(self, my_ary: np.ndarray) -> None:
+        pick = self._rng.choice(
+            self._mine, self.window_rows, replace=len(self._mine) < self.window_rows
+        )
+        for row, seq_idx in enumerate(pick):
+            start = int(seq_idx) * self.seq_len
+            my_ary[row] = self._tokens[start : start + self.seq_len]
+
+    def post_init(self, my_ary, **kw):
+        self._fill(my_ary)
+
+    def execute_function(self, my_ary, **kw):
+        self._fill(my_ary)

@@ -1,0 +1,108 @@
+"""Failure detection: producer liveness + pipeline progress watchdog.
+
+The reference had no failure detection — a dead producer deadlocked the
+trainer until an external timeout killed the job (SURVEY §5.3; its only
+detector was the pytest 100 s timeout, reference ``tests/test_ddl.py:8``).
+ddl_tpu layers three mechanisms:
+
+1. Every transport wait is bounded (``StallTimeoutError``) — built into
+   the rings.
+2. Control channels detect peer death as EOF (``PipeChannel``).
+3. This watchdog: a consumer-side monitor thread that periodically checks
+   worker liveness and ring progress and invokes a callback (default: log
+   + initiate shutdown) when a producer dies or stalls beyond its budget.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("ddl_tpu")
+
+
+class Watchdog:
+    """Monitors a WorkerSet + its rings from the consumer side."""
+
+    def __init__(
+        self,
+        workers: Any,  # ddl_tpu.env.WorkerSet
+        poll_interval_s: float = 2.0,
+        stall_budget_s: float = 120.0,
+        on_failure: Optional[Callable[[str], None]] = None,
+    ):
+        self.workers = workers
+        self.poll_interval_s = poll_interval_s
+        self.stall_budget_s = stall_budget_s
+        self.on_failure = on_failure or self._default_on_failure
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_progress: Dict[int, tuple] = {}
+        self._last_change: Dict[int, float] = {}
+        self.failures: List[str] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        self._thread = threading.Thread(
+            target=self._run, name="ddl-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.poll_interval_s * 2 + 1)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- checks ------------------------------------------------------------
+
+    def _default_on_failure(self, reason: str) -> None:
+        logger.error("watchdog: %s — initiating shutdown", reason)
+        try:
+            self.workers.abort()
+        except Exception:  # pragma: no cover - best effort
+            pass
+
+    def check_once(self) -> Optional[str]:
+        """One sweep; returns a failure description or None."""
+        for i, t in enumerate(self.workers.threads):
+            if not t.is_alive():
+                return f"producer thread {i + 1} died"
+        for i, p in enumerate(self.workers.processes):
+            if p.exitcode is not None and p.exitcode != 0:
+                return f"producer process {i + 1} exited with {p.exitcode}"
+        now = time.monotonic()
+        for i, ring in enumerate(self.workers.connection.rings):
+            st = ring.stats()
+            progress = (st["committed"], st["released"])
+            if self._last_progress.get(i) != progress:
+                self._last_progress[i] = progress
+                self._last_change[i] = now
+            elif (
+                st["committed"] == st["released"]  # producer owes a window
+                and now - self._last_change.get(i, now) > self.stall_budget_s
+            ):
+                return (
+                    f"ring {i} made no progress for {self.stall_budget_s}s "
+                    f"(committed={st['committed']:.0f})"
+                )
+        return None
+
+    def _run(self) -> None:
+        # Workers that already exited cleanly (end of run) are expected;
+        # only flag failures while the pipeline is supposed to be live.
+        while not self._stop.wait(self.poll_interval_s):
+            reason = self.check_once()
+            if reason is not None:
+                self.failures.append(reason)
+                self.on_failure(reason)
+                return
