@@ -38,6 +38,16 @@ class LlamaConfig:
     rope_theta: float = 500000.0  # Llama-3 base frequency
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # "auto": Pallas flash attention on TPU, dense elsewhere; "flash"/"dense"
+    # force one path.  Sequence-parallel meshes always use ring attention.
+    attn_impl: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.attn_impl not in ("auto", "flash", "dense"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'flash', or 'dense', "
+                f"got {self.attn_impl!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -140,7 +150,7 @@ def forward(
     dense causal attention.  RoPE positions are global either way (the
     token axis is only *sharded*, never re-indexed).
     """
-    from ddl_tpu.parallel.ring_attention import attention_reference, ring_attention
+    from ddl_tpu.parallel.ring_attention import attention
 
     B, T = tokens.shape
     dt = cfg.dtype
@@ -157,10 +167,9 @@ def forward(
         # GQA k/v stay compact: expansion happens inside the attention
         # block, so ring attention rotates 1/rep of the bytes over ICI.
         rep = cfg.n_heads // cfg.n_kv_heads
-        if mesh is not None:
-            attn = ring_attention(q, k, v, mesh, causal=True, kv_repeat=rep)
-        else:
-            attn = attention_reference(q, k, v, causal=True, kv_repeat=rep)
+        attn = attention(
+            q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True, kv_repeat=rep
+        )
         x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
 
         h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)

@@ -1,0 +1,15 @@
+"""TPU kernels (Pallas) for the hot ops.
+
+The reference ran no device compute at all (SURVEY §0: it is a data loader;
+"no model code").  ddl_tpu's consumer side does, so the ops that dominate
+its flagship training loop get hand-written TPU kernels where XLA's
+automatic fusion leaves throughput on the table — flash attention being the
+canonical case (the T×T score matrix must never round-trip HBM).
+
+Everything here runs in Pallas ``interpret`` mode on CPU (used by the test
+suite's virtual mesh) and compiles to Mosaic on real TPUs.
+"""
+
+from ddl_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

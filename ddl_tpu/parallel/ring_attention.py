@@ -18,6 +18,7 @@ attention recipe (Liu et al., blockwise attention with online softmax):
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Optional
 
 import jax
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 _NEG_INF = -1e30
+logger = logging.getLogger(__name__)
 
 
 def _block_attend(q, k, v, q_pos, k_pos, causal: bool, scale: float,
@@ -106,6 +108,108 @@ def ring_attention_shard(
     )
     l = jnp.maximum(l, 1e-30)
     return o / l.transpose(0, 2, 1)[..., None]
+
+
+def sharded_local_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Any,
+    causal: bool = True,
+    kv_repeat: int = 1,
+    use_flash: bool = False,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+) -> jax.Array:
+    """Batch/head-sharded attention for meshes WITHOUT a sequence axis.
+
+    Attention is independent across batch and heads, so on a dp/tp mesh each
+    device can run the whole (local) attention with zero collectives — but
+    only if the computation is explicitly shard_mapped; left to GSPMD, a
+    Pallas kernel is an opaque custom call and XLA would gather its operands.
+    Axes that don't divide the corresponding dimension stay unsharded.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.ops import flash_attention
+
+    def impl(q, k, v):
+        if use_flash:
+            return flash_attention(q, k, v, causal=causal, kv_repeat=kv_repeat)
+        return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat)
+
+    B, _, H, _ = q.shape
+    Hkv = k.shape[2]
+    bax = dp_axis if (
+        dp_axis in mesh.axis_names
+        and mesh.shape[dp_axis] > 1
+        and B % mesh.shape[dp_axis] == 0
+    ) else None
+    hax = tp_axis if (
+        tp_axis in mesh.axis_names
+        and mesh.shape[tp_axis] > 1
+        and H % mesh.shape[tp_axis] == 0
+        and Hkv % mesh.shape[tp_axis] == 0
+    ) else None
+    if bax is None and hax is None:
+        logger.warning(
+            "sharded_local_attention: neither %r (batch %d) nor %r "
+            "(heads %d/%d) is a shardable mesh axis — attention runs fully "
+            "replicated on every device",
+            dp_axis, B, tp_axis, H, Hkv,
+        )
+        return impl(q, k, v)
+    spec = P(bax, None, hax, None)
+    return shard_map(
+        impl, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Any] = None,
+    impl: str = "auto",
+    causal: bool = True,
+    kv_repeat: int = 1,
+    axis: str = "sp",
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+) -> jax.Array:
+    """The single attention dispatcher — one source of truth for impl/mesh
+    routing (models call this, not the individual strategies):
+
+    - mesh with a >1-sized ``axis`` (sp) → ring attention over ICI,
+    - any other mesh → batch/head-shard_mapped local attention,
+    - no mesh → plain single-device attention;
+    - ``impl``: "flash" / "dense" force the local kernel; "auto" uses the
+      Pallas flash kernel on TPU backends and dense XLA elsewhere.
+    """
+    if impl not in ("auto", "flash", "dense"):
+        raise ValueError(
+            f"impl must be 'auto', 'flash', or 'dense', got {impl!r}"
+        )
+    use_flash = impl == "flash" or (
+        impl == "auto" and jax.default_backend() == "tpu"
+    )
+    if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
+        return ring_attention(
+            q, k, v, mesh, causal=causal, axis=axis, dp_axis=dp_axis,
+            kv_repeat=kv_repeat,
+        )
+    if mesh is not None:
+        return sharded_local_attention(
+            q, k, v, mesh, causal=causal, kv_repeat=kv_repeat,
+            use_flash=use_flash, dp_axis=dp_axis, tp_axis=tp_axis,
+        )
+    if use_flash:
+        from ddl_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, kv_repeat=kv_repeat)
+    return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "kv_repeat"))

@@ -34,6 +34,37 @@ class TestLlamaModel:
             np.asarray(l1[0, :7]), np.asarray(l2[0, :7]), rtol=1e-5
         )
 
+    def test_flash_attn_impl_matches_dense(self):
+        """forward(attn_impl="flash") == forward(attn_impl="dense")."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32, attn_impl="dense")
+        cfg_flash = llama.LlamaConfig(dtype=jnp.float32, attn_impl="flash")
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 48), 0, cfg.vocab)
+        dense = llama.forward(params, tokens, cfg)
+        flash = llama.forward(params, tokens, cfg_flash)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(flash), atol=1e-4, rtol=1e-4
+        )
+
+    def test_attn_impl_validated(self):
+        with pytest.raises(ValueError, match="attn_impl"):
+            llama.LlamaConfig(attn_impl="Flash")
+
+    def test_flash_on_dp_tp_mesh_matches_dense(self):
+        """attn_impl='flash' engages (shard_mapped) on a no-sp mesh."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32, attn_impl="flash")
+        params = llama.init_params(cfg, jax.random.key(0))
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab)
+        sharded = llama.forward(params, tokens, cfg, mesh=mesh)
+        dense = llama.forward(
+            params, tokens,
+            llama.LlamaConfig(dtype=jnp.float32, attn_impl="dense"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(dense), atol=1e-4, rtol=1e-4
+        )
+
     def test_loss_decreases_under_training(self):
         cfg = llama.LlamaConfig(
             vocab=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
