@@ -1,0 +1,238 @@
+"""Mixture-of-Experts decoder LM with expert parallelism.
+
+The reference had no models and no expert parallelism (SURVEY §2.3 lists EP
+as absent); ddl_tpu makes it a first-class mesh axis.  The design is the
+TPU-idiomatic GShard/Switch formulation rather than gather/scatter token
+routing: capacity-bounded dispatch/combine einsums with fully static
+shapes, so XLA tiles every step onto the MXU and GSPMD inserts the ``ep``
+all-to-alls from sharding annotations alone — there is no hand-written
+collective and no data-dependent control flow.
+
+- Router: top-k (default 2) softmax gating, probabilities renormalised over
+  the chosen experts.
+- Dispatch: per-expert capacity ``C = ceil(topk·N/E·capacity_factor)``;
+  slot positions come from a cumulative sum over a slot-major one-hot mask
+  (earlier top-k slots get priority), overflow tokens are dropped (their
+  combine weight is zero — the residual stream carries them unchanged).
+- Experts: stacked SwiGLU MLPs ``(E, D, F)``, sharded ``P("ep", "fsdp",
+  "tp")`` so each device holds ``E/ep`` experts.
+- Load-balance aux loss: the Switch formulation
+  ``E · Σ_e fraction_dispatched(e) · mean_router_prob(e)``.
+
+Attention/norms/RoPE reuse the llama building blocks and the shared
+attention dispatcher (ring attention over ``sp``, Pallas flash kernel on
+TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddl_tpu.models import llama as _llama
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    d_ff: int = 256  # per-expert hidden size
+    n_experts: int = 4
+    topk: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    max_seq: int = 512
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def capacity(self, n_tokens: int) -> int:
+        per_expert = self.topk * n_tokens / self.n_experts
+        return max(1, math.ceil(per_expert * self.capacity_factor))
+
+    @staticmethod
+    def tiny() -> "MoeConfig":
+        return MoeConfig()
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoeConfig":
+        """Mixtral-8x7B dimensions — the pod-scale EP design point."""
+        return MoeConfig(
+            vocab=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, n_experts=8, topk=2, max_seq=8192,
+        )
+
+
+def init_params(cfg: MoeConfig, key: jax.Array) -> Params:
+    keys = iter(jax.random.split(key, 2 + cfg.n_layers * 9))
+
+    def dense(k, fan_in, shape):
+        return jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+
+    d, hd, E, F = cfg.d_model, cfg.head_dim, cfg.n_experts, cfg.d_ff
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn_norm": jnp.ones((d,), jnp.float32),
+                "wq": dense(next(keys), d, (d, cfg.n_heads * hd)),
+                "wk": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
+                "wv": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
+                "wo": dense(next(keys), cfg.n_heads * hd, (cfg.n_heads * hd, d)),
+                "mlp_norm": jnp.ones((d,), jnp.float32),
+                "w_router": dense(next(keys), d, (d, E)),
+                "w_gate": dense(next(keys), d, (E, d, F)),
+                "w_up": dense(next(keys), d, (E, d, F)),
+                "w_down": dense(next(keys), F, (E, F, d)),
+            }
+        )
+    return {
+        "embed": dense(next(keys), d, (cfg.vocab, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(next(keys), d, (d, cfg.vocab)),
+    }
+
+
+def param_specs(cfg: MoeConfig) -> Params:
+    """Expert weights shard their leading E axis over ``ep``; within an
+    expert the dense Megatron layout (fsdp × tp) applies.  Axes absent from
+    the mesh are dropped by the train-step factory."""
+    layer = {
+        "attn_norm": P(None),
+        "wq": P("fsdp", "tp"),
+        "wk": P("fsdp", "tp"),
+        "wv": P("fsdp", "tp"),
+        "wo": P("tp", "fsdp"),
+        "mlp_norm": P(None),
+        "w_router": P(None, None),
+        "w_gate": P("ep", "fsdp", "tp"),
+        "w_up": P("ep", "fsdp", "tp"),
+        "w_down": P("ep", "tp", "fsdp"),
+    }
+    return {
+        "embed": P(None, "fsdp"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def moe_mlp(
+    x: jax.Array, layer: Params, cfg: MoeConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routed SwiGLU experts over flat tokens x: (N, D).
+
+    Returns (out (N, D), aux load-balance loss scalar).
+    """
+    N, D = x.shape
+    E, k, C = cfg.n_experts, cfg.topk, cfg.capacity(N)
+    dt = x.dtype
+
+    router_logits = (x @ layer["w_router"].astype(dt)).astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (N, E)
+    top_p, top_e = jax.lax.top_k(probs, k)  # (N, k)
+    top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+
+    mask = jax.nn.one_hot(top_e, E, dtype=jnp.float32)  # (N, k, E)
+    # Slot-major priority: all slot-0 picks queue before any slot-1 pick.
+    mask_f = mask.transpose(1, 0, 2).reshape(k * N, E)
+    pos_f = jnp.cumsum(mask_f, axis=0) - mask_f  # arrival index per expert
+    pos = (pos_f * mask_f).sum(-1).reshape(k, N).T.astype(jnp.int32)  # (N, k)
+    keep = (pos < C) & (mask.sum(-1) > 0)  # (N, k) boolean
+
+    gates = top_p * keep  # dropped tokens get zero combine weight
+    # combine[n, e, c] = gate weight of token n at expert e slot c
+    combine = jnp.einsum(
+        "nk,nke,nkc->nec",
+        gates,
+        mask,
+        jax.nn.one_hot(pos, C, dtype=jnp.float32),
+    )
+    dispatch = (combine > 0).astype(dt)  # (N, E, C)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)  # (E, C, D)
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(dt))
+    )
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(dt))
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", gate * up, layer["w_down"].astype(dt)
+    )
+    out = jnp.einsum("nec,ecd->nd", combine.astype(dt), expert_out)
+
+    # Switch load-balance loss on slot-0 dispatch decisions.
+    frac_dispatched = jnp.mean(mask[:, 0, :], axis=0)  # (E,)
+    mean_prob = jnp.mean(probs, axis=0)  # (E,)
+    aux = E * jnp.sum(frac_dispatched * mean_prob)
+    return out, aux
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    mesh: Optional[Any] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(logits (B, T, vocab), mean router aux loss)."""
+    from ddl_tpu.parallel.ring_attention import attention
+
+    B, T = tokens.shape
+    dt = cfg.dtype
+    positions = jnp.arange(T)
+    x = params["embed"].astype(dt)[tokens]
+    aux_total = jnp.zeros((), jnp.float32)
+
+    for layer in params["layers"]:
+        h = _llama._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        kk = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = _llama._rope(q, positions, cfg.rope_theta)
+        kk = _llama._rope(kk, positions, cfg.rope_theta)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        attn = attention(
+            q, kk, v, mesh=mesh, impl=cfg.attn_impl, causal=True, kv_repeat=rep
+        )
+        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+
+        h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        moe_out, aux = moe_mlp(h.reshape(B * T, -1), layer, cfg)
+        x = x + moe_out.reshape(B, T, -1)
+        aux_total = aux_total + aux
+
+    x = _llama._rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, aux_total / cfg.n_layers
+
+
+def next_token_loss(
+    params: Params,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    mesh: Optional[Any] = None,
+) -> jax.Array:
+    """Cross-entropy + weighted router load-balance loss."""
+    B, T = tokens.shape
+    logits, aux = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    maskv = (jnp.arange(T) < T - 1).astype(ll.dtype)[None, :]
+    ce = -jnp.sum(ll * maskv) / (B * (T - 1))
+    return ce + cfg.router_aux_weight * aux

@@ -1,18 +1,25 @@
-"""Causal flash attention as a Pallas TPU kernel.
+"""Causal flash attention as Pallas TPU kernels (forward + backward).
 
 Blockwise attention with online softmax (the same math as
-``parallel/ring_attention.py``, which runs it *across* devices; this kernel
-runs it *within* one device so the (T, T) score matrix never leaves VMEM):
+``parallel/ring_attention.py``, which runs it *across* devices; these
+kernels run it *within* one device so the (T, T) score matrix never leaves
+VMEM):
 
-- grid = (batch, heads, Q blocks, KV blocks); the innermost KV axis is
-  sequential on TPU, so running max / denominator / output accumulate in
-  VMEM scratch across KV steps and the output block is written once, on the
-  last step.
+- Forward: grid = (batch, heads, Q blocks, KV blocks); the innermost KV
+  axis is sequential on TPU, so running max / denominator / output
+  accumulate in VMEM scratch across KV steps and the output block is
+  written once, on the last step.  The per-row logsumexp is emitted as a
+  residual for the backward pass.
+- Backward (the standard two-kernel flash backward): dQ accumulates over
+  KV blocks for a fixed Q block; dK/dV accumulate over Q blocks for a
+  fixed KV block.  Probabilities are recomputed from the saved logsumexp —
+  nothing quadratic is ever materialised.  Under GQA the per-Q-head dK/dV
+  are summed over each query-head group outside the kernel.
 - K/V stay compact under grouped-query attention — the head index map
-  divides by ``kv_repeat``, so each KV head's block is fetched from HBM
-  once per Q-head group member but never materialised expanded.
+  divides by ``kv_repeat``.
 - Causal masking uses global token positions; blocks strictly above the
-  diagonal skip the matmul entirely (``pl.when``), saving ~half the FLOPs.
+  diagonal skip their matmuls entirely (``pl.when``), saving ~half the
+  FLOPs.
 
 The public wrapper pads ragged sequence lengths to the block size (padded
 keys are masked out, padded query rows sliced off) and falls back to
@@ -34,9 +41,19 @@ _NEG_INF = -1e30
 _LANES = 128  # TPU vector lane count: scratch accumulators are (bq, 128)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-            scale: float, causal: bool, block_q: int, block_k: int,
-            seq_len: int, precision):
+def _positions(i, j, block_q, block_k):
+    q_pos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos, k_pos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                seq_len: int, precision):
     i = pl.program_id(2)  # Q block
     j = pl.program_id(3)  # KV block (innermost, sequential)
 
@@ -60,12 +77,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             precision=precision,
         ) * scale  # (bq, bk)
 
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
+        q_pos, k_pos = _positions(i, j, block_q, block_k)
         invalid = k_pos >= seq_len  # padded keys
         if causal:
             invalid |= k_pos > q_pos
@@ -94,11 +106,275 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finish():
+        m = jnp.max(m_ref[:], axis=-1)
         l = jnp.max(l_ref[:], axis=-1)
-        l = jnp.where(l == 0.0, 1.0, l)  # rows with no valid keys -> 0 output
-        o_ref[0, 0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        # logsumexp residual; -inf marks rows with no valid keys.
+        lse = jnp.where(
+            l > 0.0, jnp.where(m <= _NEG_INF / 2, 0.0, m) + jnp.log(l),
+            _NEG_INF,
+        )
+        lse_ref[0, 0] = lse[:, None]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
+def _recompute_p(q, k, lse, i, j, *, scale, causal, block_q, block_k,
+                 seq_len, precision):
+    """p_ij = exp(s_ij - lse_i), zeroed on masked/padded/empty rows."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ) * scale
+    q_pos, k_pos = _positions(i, j, block_q, block_k)
+    invalid = (k_pos >= seq_len) | (q_pos >= seq_len)
+    if causal:
+        invalid |= k_pos > q_pos
+    empty = lse <= _NEG_INF / 2  # (bq,)
+    p = jnp.exp(s - jnp.where(empty, 0.0, lse)[:, None])
+    return jnp.where(invalid | empty[:, None], 0.0, p)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale: float, causal: bool, block_q: int,
+               block_k: int, seq_len: int, precision):
+    i = pl.program_id(2)  # Q block
+    j = pl.program_id(3)  # KV block (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        p = _recompute_p(
+            q, k, lse_ref[0, 0][:, 0], i, j, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=seq_len,
+            precision=precision,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, scale: float, causal: bool,
+                block_q: int, block_k: int, seq_len: int, precision):
+    j = pl.program_id(2)  # KV block
+    i = pl.program_id(3)  # Q block (innermost, sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else (i >= 0)
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        p = _recompute_p(
+            q, k, lse_ref[0, 0][:, 0], i, j, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=seq_len,
+            precision=precision,
+        )  # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )  # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _prep(q, k, v, block_q, block_k):
+    """Common layout work: (B,T,H,D)→(B,H,T,D), tile-aligned blocks, pads."""
+    B, T, H, D = q.shape
+    tile = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(q.dtype).itemsize, 8)
+    align = lambda n: -(-n // tile) * tile  # noqa: E731
+    block_q = min(block_q, align(max(T, 1)))
+    block_k = min(block_k, align(max(T, 1)))
+    pad_q = (-T) % block_q
+    pad_k = (-T) % block_k
+    qt = jnp.moveaxis(q, 2, 1)
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    return qt, kt, vt, block_q, block_k
+
+
+def _precision_for(dtype):
+    # f32 inputs get 6-pass MXU precision (err ~1e-6 vs the single-pass
+    # bf16 default's ~5e-3 — enough to perturb small-key-count softmax
+    # rows); bf16 inputs keep the fast default, as everywhere else.
+    return (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+
+def _fwd_impl(q, k, v, causal, kv_repeat, block_q, block_k, interpret):
+    assert q.shape[2] == k.shape[2] * kv_repeat, (q.shape, k.shape, kv_repeat)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, T, H, D = q.shape
+    qt, kt, vt, block_q, block_k = _prep(q, k, v, block_q, block_k)
+    Tq, Tk = qt.shape[2], kt.shape[2]
+    precision = _precision_for(q.dtype)
+    kernel = functools.partial(
+        _fwd_kernel, scale=1.0 / (D**0.5), causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=T, precision=precision,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D),
+        lambda b, h, i, j, rep=kv_repeat: (b, h // rep, j, 0),
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, Tq // block_q, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            # Row residual carries a trailing singleton lane dim: TPU block
+            # shapes need the last two dims tile-aligned or whole-array.
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    o = out[:, :, :T] if Tq != T else out
+    return jnp.moveaxis(o, 1, 2), (out, lse, interpret, block_q, block_k)
+
+
+def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, do):
+    # Resolved block sizes / interpret flag ride in the residuals so both
+    # passes use identical values (the nondiff args are pre-resolution).
+    q, k, v, out_padded, lse, interpret, block_q, block_k = res
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    qt, kt, vt, block_q, block_k = _prep(q, k, v, block_q, block_k)
+    Tq, Tk = qt.shape[2], kt.shape[2]
+    precision = _precision_for(q.dtype)
+
+    dot = jnp.moveaxis(do, 2, 1)
+    if Tq != T:
+        dot = jnp.pad(dot, ((0, 0), (0, 0), (0, Tq - T), (0, 0)))
+    # delta_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term.
+    delta = jnp.sum(
+        dot.astype(jnp.float32) * out_padded.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # (B, H, Tq, 1)
+
+    common = dict(
+        scale=1.0 / (D**0.5), causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=T, precision=precision,
+    )
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D),
+        lambda b, h, i, j, rep=kv_repeat: (b, h // rep, j, 0),
+    )
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(B, H, Tq // block_q, Tk // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dK/dV: grid transposed so the Q axis is innermost (sequential).
+    q_spec_t = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec_t = pl.BlockSpec(
+        (1, 1, block_k, D),
+        lambda b, h, j, i, rep=kv_repeat: (b, h // rep, j, 0),
+    )
+    row_spec_t = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)
+    )
+    out_kv_t = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(B, H, Tk // block_k, Tq // block_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[out_kv_t, out_kv_t],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    if Tq != T:
+        dq = dq[:, :, :T]
+    if Tk != T:
+        dk = dk[:, :, :T]
+        dv = dv[:, :, :T]
+    dq = jnp.moveaxis(dq, 1, 2)
+    # Per-Q-head dK/dV collapse onto the compact KV heads (GQA group sum).
+    if kv_repeat > 1:
+        dk = dk.reshape(B, Hkv, kv_repeat, T, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, kv_repeat, T, D).sum(axis=2)
+    dk = jnp.moveaxis(dk, 1, 2)
+    dv = jnp.moveaxis(dv, 1, 2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -113,77 +389,18 @@ def flash_attention(
 
     k/v are compact GQA tensors of shape (B, T, H // kv_repeat, D).  Output
     matches ``parallel.ring_attention.attention_reference`` up to fp
-    accumulation order.  Off-TPU the kernel runs in Pallas interpret mode.
+    accumulation order; fully differentiable (flash backward kernels).
+    Off-TPU the kernels run in Pallas interpret mode.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    B, T, H, D = q.shape
-    Hkv = k.shape[2]
-    assert H == Hkv * kv_repeat, (H, Hkv, kv_repeat)
+    out, _ = _fwd_impl(q, k, v, causal, kv_repeat, block_q, block_k, interpret)
+    return out
 
-    # Shrink oversized blocks only down to a tile-aligned size (sublane
-    # tile: 8 for f32, 16 for bf16, 32 for 8-bit) — a block of raw T would
-    # hand Mosaic a non-tile-aligned shape.
-    tile = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(q.dtype).itemsize, 8)
-    align = lambda n: -(-n // tile) * tile  # noqa: E731
-    block_q = min(block_q, align(max(T, 1)))
-    block_k = min(block_k, align(max(T, 1)))
-    pad_q = (-T) % block_q
-    pad_k = (-T) % block_k
-    # (B, H, T, D) layout so T and D are the tiled minor dims.
-    qt = jnp.moveaxis(q, 2, 1)
-    kt = jnp.moveaxis(k, 2, 1)
-    vt = jnp.moveaxis(v, 2, 1)
-    if pad_q:
-        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
-    if pad_k:
-        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-    Tq, Tk = qt.shape[2], kt.shape[2]
 
-    grid = (B, H, Tq // block_q, Tk // block_k)
-    # f32 inputs get 6-pass MXU precision (err ~1e-6 vs the single-pass
-    # bf16 default's ~5e-3 — enough to perturb small-key-count softmax
-    # rows); bf16 inputs keep the fast default, as everywhere else.
-    precision = (
-        jax.lax.Precision.HIGHEST
-        if q.dtype == jnp.float32
-        else jax.lax.Precision.DEFAULT
+def _vjp_fwd(q, k, v, causal, kv_repeat, block_q, block_k, interpret):
+    out, (out_padded, lse, ipret, bq, bk) = _fwd_impl(
+        q, k, v, causal, kv_repeat, block_q, block_k, interpret
     )
-    kernel = functools.partial(
-        _kernel,
-        scale=1.0 / (D**0.5),
-        causal=causal,
-        block_q=block_q,
-        block_k=block_k,
-        seq_len=T,
-        precision=precision,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec(
-                (1, 1, block_k, D),
-                lambda b, h, i, j, rep=kv_repeat: (b, h // rep, j, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, D),
-                lambda b, h, i, j, rep=kv_repeat: (b, h // rep, j, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
-            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom l
-            pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
-        ],
-        interpret=interpret,
-    )(qt, kt, vt)
-    if pad_q:
-        out = out[:, :, :T]
-    return jnp.moveaxis(out, 1, 2)
+    return out, (q, k, v, out_padded, lse, ipret, bq, bk)
+
+
+flash_attention.defvjp(_vjp_fwd, _bwd_impl)

@@ -7,9 +7,17 @@ mesh axes; collectives are XLA ops inserted by ``shard_map``/``pjit``.
 
 from ddl_tpu.parallel.collectives import DeviceGlobalShuffler
 from ddl_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+from ddl_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_spec,
+    stack_stage_params,
+)
 
 __all__ = [
     "DeviceGlobalShuffler",
     "data_parallel_mesh",
     "make_mesh",
+    "pipeline_apply",
+    "pipeline_spec",
+    "stack_stage_params",
 ]

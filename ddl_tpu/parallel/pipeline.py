@@ -1,0 +1,153 @@
+"""Pipeline (model) parallelism over a ``pp`` mesh axis.
+
+Absent from the reference (SURVEY §2.3 lists PP as "—"); ddl_tpu implements
+the TPU-idiomatic form: a GPipe microbatch schedule written as a single
+``lax.scan`` under ``shard_map``, with activations hopping one ICI step per
+tick via ``lax.ppermute``.  No host round trips, no per-stage programs —
+one SPMD program where every device runs the same loop and the stage index
+selects behaviour with ``where`` masks (compiler-friendly control flow, no
+data-dependent branching).
+
+Schedule (S stages, M microbatches, steps t = 0 .. S+M-2):
+
+- stage 0 feeds microbatch t into the pipe while t < M,
+- every stage applies its layer to the buffer it received,
+- results hop to the next stage between ticks,
+- stage S-1 emits microbatch t-S+1 for t >= S-1; outputs are returned to
+  every device by a masked ``psum`` (valid only on the last stage before
+  it).
+
+The whole schedule is differentiable, so ``jax.grad`` through
+``pipeline_apply`` yields the reverse schedule automatically — 1F1B-style
+interleaving is left to XLA's scheduler rather than hand-written.
+
+Stage parameters are user-stacked with a leading S axis sharded
+``P("pp", ...)`` — at-rest storage holds only each device's own stage
+(plus any fsdp/tp sharding of the trailing axes).  Inside the pipeline's
+``shard_map`` each device needs its stage's weights IN FULL (``stage_fn``
+is a plain local function), so trailing-axis shards are gathered at the
+shard_map boundary each step — pp composes with fsdp/tp for storage, not
+for per-step working memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(per_stage: list) -> Any:
+    """Stack a list of per-stage param pytrees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def pipeline_spec(inner_spec_tree: Any, axis: str = "pp") -> Any:
+    """Prepend the pipeline axis to every leaf spec of a stage param tree.
+
+    Pass the same ``axis`` used in :func:`pipeline_apply`.
+    """
+    return jax.tree.map(
+        lambda s: P(axis, *tuple(s)),
+        inner_spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _pipeline_shard(params_local: Any, x: jax.Array, *, stage_fn, axis: str,
+                    n_micro: int):
+    """Per-device body (under shard_map over ``axis``).
+
+    params_local leaves have leading dim 1 (this device's stage); x is the
+    full (M, mb, ...) microbatched input, replicated over ``axis``.
+    """
+    S = lax.psum(1, axis)
+    my_stage = lax.axis_index(axis)
+    params_my = jax.tree.map(lambda p: p[0], params_local)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 ingests microbatch t (clamped once the pipe is draining).
+        feed = x[jnp.minimum(t, n_micro - 1)]
+        inp = jnp.where(my_stage == 0, feed, buf)
+        y = stage_fn(params_my, inp)
+        # Last stage emits microbatch t-S+1 once the pipe is full.
+        out_idx = t - (S - 1)
+        valid = (my_stage == S - 1) & (out_idx >= 0)
+        outputs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), 0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        buf = lax.ppermute(y, axis, fwd_perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(x[0])
+    out0 = jnp.zeros((n_micro,) + x.shape[1:], x.dtype)
+    (_, outputs), _ = lax.scan(
+        tick, (buf0, out0), jnp.arange(n_micro + S - 1)
+    )
+    # Outputs are populated only on the last stage; psum broadcasts them.
+    return lax.psum(
+        jnp.where(my_stage == S - 1, outputs, jnp.zeros_like(outputs)), axis
+    )
+
+
+def pipeline_apply(
+    stacked_params: Any,
+    x: jax.Array,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+    batch_spec: P = P(),
+) -> jax.Array:
+    """Apply S pipelined stages to a batch x (B, ...).
+
+    - ``stacked_params``: stage params stacked on a leading S axis (see
+      :func:`stack_stage_params`), sharded ``P(axis, ...)``.
+    - ``stage_fn(stage_params, x) -> y`` with y.shape == x.shape (uniform
+      inter-stage activations, the usual transformer-block case).
+    - Falls back to a sequential scan over stages when the mesh has no
+      ``axis`` (or size 1) — same math, no pipelining.
+
+    B must divide into ``n_microbatches``; ``batch_spec`` optionally keeps
+    the microbatch dimension sharded (e.g. ``P(None, "dp")``) — the default
+    replicates the batch over the pipeline group.
+    """
+    S = jax.tree.leaves(stacked_params)[0].shape[0]
+    B = x.shape[0]
+    assert B % n_microbatches == 0, (B, n_microbatches)
+    xm = x.reshape((n_microbatches, B // n_microbatches) + x.shape[1:])
+
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        out, _ = lax.scan(lambda h, p: (stage_fn(p, h), None),
+                          x, stacked_params)
+        return out
+    assert mesh.shape[axis] == S, (
+        f"stacked params have {S} stages but mesh {axis}={mesh.shape[axis]}"
+    )
+
+    from jax import shard_map
+
+    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    fn = shard_map(
+        functools.partial(
+            _pipeline_shard, stage_fn=stage_fn, axis=axis,
+            n_micro=n_microbatches,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )
+    out = fn(stacked_params, xm)
+    return out.reshape(x.shape)

@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any, Callable, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 
 def _named(mesh: Any, spec_tree: Any) -> Any:
@@ -38,6 +41,34 @@ def _named(mesh: Any, spec_tree: Any) -> Any:
     return jax.tree.map(
         fix, spec_tree, is_leaf=lambda x: isinstance(x, P)
     )
+
+
+def _prune_indivisible(sh: NamedSharding, x: Any) -> NamedSharding:
+    """Drop spec axes whose mesh size doesn't divide the array dimension
+    (e.g. 2 experts on an ep=8 mesh) — the leaf degrades to replicated on
+    that dimension instead of failing sharding validation."""
+    mesh = sh.mesh
+    if len(tuple(sh.spec)) > np.ndim(x):
+        raise ValueError(
+            f"param spec {sh.spec} has more entries than array rank "
+            f"{np.ndim(x)} (shape {np.shape(x)})"
+        )
+    parts = []
+    for dim_size, entry in zip(
+        np.shape(x), tuple(sh.spec) + (None,) * len(np.shape(x))
+    ):
+        axes = (entry,) if isinstance(entry, str) else tuple(entry or ())
+        n = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+        keep = n > 1 and dim_size % n == 0
+        if axes and n > 1 and not keep:
+            logger.warning(
+                "param spec axis %r (size %d) does not divide dim %d of "
+                "shape %s — that dimension degrades to REPLICATED (memory "
+                "cost: full copy per device group)",
+                entry, n, dim_size, np.shape(x),
+            )
+        parts.append(entry if keep else None)
+    return NamedSharding(mesh, P(*parts))
 
 
 @dataclasses.dataclass
@@ -76,7 +107,8 @@ def make_train_step(
         # already live on a target device (e.g. replicated specs), and the
         # donated train step would then delete the caller's input tree.
         # A compiled copy guarantees fresh buffers the step may donate.
-        params = jax.jit(lambda t: t, out_shardings=param_sh)(params)
+        sh = jax.tree.map(_prune_indivisible, param_sh, params)
+        params = jax.jit(lambda t: t, out_shardings=sh)(params)
         # optax states are built leaf-wise from params (zeros_like etc.), so
         # moments inherit the param shardings — fsdp shards the optimizer
         # state for free (the ZeRO property).  Leaves NOT derived from

@@ -17,6 +17,7 @@ Channel realisations:
 from __future__ import annotations
 
 import abc
+import copy
 import queue as queue_mod
 from typing import Any, List, Optional, Sequence
 
@@ -125,8 +126,21 @@ class ConsumerConnection:
         return len(self.channels)
 
     def send_metadata(self, meta: MetaData_Consumer_To_Producer) -> None:
+        # Each producer gets a DEEP COPY of the metadata (and with it the
+        # user's producer function) so THREAD mode has the same
+        # code-shipping semantics as PROCESS mode's pickling (reference
+        # pickled over ssend, connection.py:73): a shared instance would
+        # race on user state (shard cursors, RNGs) across producer threads.
+        # deepcopy rather than a pickle round-trip keeps thread mode usable
+        # with locally-defined producer classes.  Only this broadcast is
+        # copied — ring handles and tokens on other paths must stay shared —
+        # and only for thread channels: PipeChannel already copies by
+        # pickling, so copying there would double the peak memory of a
+        # producer function that closes over a large dataset.
         for ch in self.channels:
-            ch.send(meta)
+            ch.send(
+                copy.deepcopy(meta) if isinstance(ch, ThreadChannel) else meta
+            )
 
     def recv_metadata_as_consumer(self) -> List[MetaData_Producer_To_Consumer]:
         replies = [ch.recv() for ch in self.channels]

@@ -1,0 +1,115 @@
+"""MoE routing + expert-parallel training tests (virtual 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ddl_tpu.models import moe
+from ddl_tpu.parallel.mesh import make_mesh
+from ddl_tpu.parallel.train import make_train_step
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=64, n_experts=4, dtype=jnp.float32,
+    )
+    base.update(kw)
+    return moe.MoeConfig(**base)
+
+
+class TestRouting:
+    def test_combine_weights_sum_to_one_without_drops(self, rng):
+        """With ample capacity every token's gates survive and sum to 1."""
+        cfg = _cfg(capacity_factor=4.0)
+        params = moe.init_params(cfg, jax.random.key(0))
+        x = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+        layer = params["layers"][0]
+        out, aux = moe.moe_mlp(x, layer, cfg)
+        assert out.shape == x.shape
+        # Rebuild combine mass: run the router math independently.
+        probs = jax.nn.softmax(
+            (x @ layer["w_router"]).astype(jnp.float32), -1
+        )
+        top_p, _ = jax.lax.top_k(probs, cfg.topk)
+        np.testing.assert_allclose(np.sum(top_p / top_p.sum(-1, keepdims=True)),
+                                   x.shape[0], rtol=1e-5)
+
+    def test_capacity_drops_overflow_tokens(self, rng):
+        """Tiny capacity: output is attenuated (dropped tokens add nothing)
+        but still finite and shaped right."""
+        cfg = _cfg(capacity_factor=0.1)
+        params = moe.init_params(cfg, jax.random.key(0))
+        x = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+        out, _ = moe.moe_mlp(x, params["layers"][0], cfg)
+        assert np.isfinite(np.asarray(out)).all()
+        n_live = int(np.sum(np.abs(np.asarray(out)).sum(-1) > 0))
+        assert n_live <= cfg.capacity(64) * cfg.n_experts
+
+    def test_aux_loss_is_one_when_balanced(self):
+        """Uniform router → Switch aux loss == 1 (its minimum)."""
+        cfg = _cfg()
+        params = moe.init_params(cfg, jax.random.key(0))
+        layer = dict(params["layers"][0])
+        layer["w_router"] = jnp.zeros_like(layer["w_router"])  # uniform probs
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((256, 32)), jnp.float32
+        )
+        _, aux = moe.moe_mlp(x, layer, cfg)
+        # frac_dispatched comes from top_k tie-breaking (argmax order), so
+        # only mean_prob is exactly uniform; aux stays at E * sum(f_e / E)=1.
+        np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
+
+
+class TestMoeModel:
+    def test_forward_finite_and_shapes(self, rng):
+        cfg = _cfg(n_layers=2)
+        params = moe.init_params(cfg, jax.random.key(0))
+        tokens = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+        logits, aux = moe.forward(params, tokens, cfg)
+        assert logits.shape == (2, 16, 64)
+        assert np.isfinite(np.asarray(logits)).all()
+        assert float(aux) > 0
+
+    def test_loss_decreases_on_ep_mesh(self):
+        cfg = _cfg()
+        mesh = make_mesh({"dp": 2, "ep": 4})
+        params = moe.init_params(cfg, jax.random.key(0))
+        init_fn, step_fn = make_train_step(
+            lambda p, b: moe.next_token_loss(p, b, cfg, mesh=mesh),
+            optax.adamw(1e-2), mesh, moe.param_specs(cfg),
+            batch_spec=P(("dp",)),
+        )
+        state = init_fn(params)
+        tokens = np.tile(np.arange(16, dtype=np.int32) % 7, (8, 1))
+        losses = []
+        for _ in range(15):
+            state, loss = step_fn(state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
+        # Expert weights actually sharded over ep.
+        assert "ep" in str(state.params["layers"][0]["w_gate"].sharding.spec)
+
+    @pytest.mark.parametrize("axes,batch_spec", [
+        ({"ep": 8}, P(None)),
+        ({"dp": 2, "ep": 2, "tp": 2}, P(("dp",))),
+        ({"dp": 2, "sp": 2, "ep": 2}, P("dp", "sp")),
+    ])
+    def test_step_on_mixed_meshes(self, axes, batch_spec):
+        cfg = _cfg(n_experts=2)
+        mesh = make_mesh(dict(axes))
+        params = moe.init_params(cfg, jax.random.key(0))
+        init_fn, step_fn = make_train_step(
+            lambda p, b: moe.next_token_loss(p, b, cfg, mesh=mesh),
+            optax.adamw(1e-3), mesh, moe.param_specs(cfg),
+            batch_spec=batch_spec,
+        )
+        state = init_fn(params)
+        tokens = np.random.default_rng(0).integers(0, 64, (8, 16), dtype=np.int32)
+        state, l1 = step_fn(state, tokens)
+        state, l2 = step_fn(state, tokens)
+        assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+        assert float(l2) < float(l1)

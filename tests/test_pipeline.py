@@ -1,0 +1,92 @@
+"""Pipeline-parallel schedule tests (virtual 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ddl_tpu.parallel.mesh import make_mesh
+from ddl_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_spec,
+    stack_stage_params,
+)
+from ddl_tpu.parallel.train import make_train_step
+
+D = 16
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stages(rng, n):
+    return [
+        {
+            "w": jnp.asarray(rng.standard_normal((D, D)) / 4, jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((D,)) / 4, jnp.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_pipeline_matches_sequential(rng):
+    """pp=4 pipelined output == applying the 4 stages in sequence."""
+    stages = _stages(rng, 4)
+    stacked = stack_stage_params(stages)
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    x = jnp.asarray(rng.standard_normal((8, D)), jnp.float32)
+    out = pipeline_apply(stacked, x, _stage_fn, mesh, n_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_sequential(stages, x)), atol=1e-5
+    )
+
+
+def test_pipeline_fallback_no_pp_axis(rng):
+    stages = _stages(rng, 3)
+    stacked = stack_stage_params(stages)
+    mesh = make_mesh({"dp": 8})
+    x = jnp.asarray(rng.standard_normal((4, D)), jnp.float32)
+    out = pipeline_apply(stacked, x, _stage_fn, mesh, n_microbatches=2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_sequential(stages, x)), atol=1e-5
+    )
+
+
+def test_pipeline_spec_prepends_pp():
+    spec = pipeline_spec({"w": P("fsdp", "tp"), "b": P(None)})
+    assert spec["w"] == P("pp", "fsdp", "tp")
+    assert spec["b"] == P("pp", None)
+
+
+def test_pipeline_gradients_train(rng):
+    """A pipelined regression model trains end-to-end on a pp×dp mesh —
+    grads flow backwards through the ppermute schedule."""
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    stacked = stack_stage_params(_stages(rng, 4))
+    x = jnp.asarray(rng.standard_normal((16, D)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((16, D)) * 0.1, jnp.float32)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        pred = pipeline_apply(params, xb, _stage_fn, mesh, n_microbatches=4)
+        return jnp.mean((pred - yb) ** 2)
+
+    init_fn, step_fn = make_train_step(
+        loss_fn, optax.adam(1e-2), mesh,
+        pipeline_spec({"w": P(None, None), "b": P(None)}),
+        batch_spec=P(),
+    )
+    state = init_fn(stacked)
+    losses = []
+    for _ in range(30):
+        state, loss = step_fn(state, (x, y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

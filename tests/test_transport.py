@@ -169,3 +169,77 @@ class TestNativeBuild:
     def test_native_compiles_here(self):
         """This image ships g++ — the native path must be the active one."""
         assert native_available()
+
+
+class TestThreadChannelIsolation:
+    def test_metadata_broadcast_copies_payload(self):
+        """THREAD mode must ship a COPY of the producer function to each
+        producer (process-mode pickle semantics): a shared instance races
+        on user state (shard cursors, RNGs) across producer threads."""
+        from ddl_tpu.transport.connection import ConsumerConnection, ThreadChannel
+        from ddl_tpu.types import MetaData_Consumer_To_Producer
+
+        a1, b1 = ThreadChannel.pair()
+        a2, b2 = ThreadChannel.pair()
+        conn = ConsumerConnection([a1, a2])
+        meta = MetaData_Consumer_To_Producer(
+            data_producer_function={"cursor": [1, 2, 3]},
+            batch_size=1, n_epochs=1,
+            global_shuffle_fraction_exchange=0.0,
+            exchange_method="sendrecv_replace",
+        )
+        conn.send_metadata(meta)
+        r1 = b1.recv(timeout_s=5)
+        r2 = b2.recv(timeout_s=5)
+        f0 = meta.data_producer_function
+        assert r1.data_producer_function == f0 == r2.data_producer_function
+        assert r1.data_producer_function is not f0
+        assert r2.data_producer_function is not f0
+        assert r1.data_producer_function is not r2.data_producer_function
+
+    def test_producers_get_distinct_function_instances(self):
+        """End-to-end: two producer threads must not share one skeleton."""
+        import ddl_tpu
+        from ddl_tpu import (
+            DataProducerOnInitReturn,
+            DistributedDataLoader,
+            Marker,
+            ProducerFunctionSkeleton,
+            distributed_dataloader,
+        )
+
+        class IdProducer(ProducerFunctionSkeleton):
+            def __init__(self):
+                self.idx = None
+
+            def on_init(self, producer_idx=0, **kw):
+                self.idx = producer_idx
+                return DataProducerOnInitReturn(
+                    nData=8, nValues=2, shape=(8, 2), splits=(1, 1)
+                )
+
+            def post_init(self, my_ary, **kw):
+                # Window carries the idx this INSTANCE saw in on_init; with
+                # a shared instance both windows would show the same idx.
+                my_ary[:] = float(self.idx)
+
+            def execute_function(self, my_ary, **kw):
+                my_ary[:] = float(self.idx)
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                IdProducer(), batch_size=8, connection=env.connection,
+                n_epochs=2, output="numpy",
+            )
+            seen = set()
+            for _ in range(2):
+                for x, _y in loader:
+                    seen.add(float(x[0, 0]))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return seen
+
+        # Producers are indexed 1..N (the consumer is rank 0, mirroring the
+        # reference's shm-rank topology, ddl_env.py:115-124).
+        assert main() == {1.0, 2.0}
