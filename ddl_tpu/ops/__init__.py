@@ -10,6 +10,9 @@ Everything here runs in Pallas ``interpret`` mode on CPU (used by the test
 suite's virtual mesh) and compiles to Mosaic on real TPUs.
 """
 
-from ddl_tpu.ops.flash_attention import flash_attention
+from ddl_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]

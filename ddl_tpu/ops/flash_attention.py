@@ -9,31 +9,40 @@ VMEM):
   axis is sequential on TPU, so running max / denominator / output
   accumulate in VMEM scratch across KV steps and the output block is
   written once, on the last step.  The per-row logsumexp is emitted as a
-  residual for the backward pass.
+  residual for the backward pass and (via
+  :func:`flash_attention_with_lse`) for cross-device online-softmax
+  combination — ring attention calls this kernel once per ring step and
+  merges steps with the logsumexp identity.
 - Backward (the standard two-kernel flash backward): dQ accumulates over
   KV blocks for a fixed Q block; dK/dV accumulate over Q blocks for a
   fixed KV block.  Probabilities are recomputed from the saved logsumexp —
-  nothing quadratic is ever materialised.  Under GQA the per-Q-head dK/dV
-  are summed over each query-head group outside the kernel.
+  nothing quadratic is ever materialised.  An incoming lse cotangent
+  (from the ring combine) folds into the score gradient as
+  ``ds += p * dlse`` (since d lse_i / d s_ik = p_ik).  Under GQA the
+  per-Q-head dK/dV are summed over each query-head group outside the
+  kernel.
+- Global-position offsets ride in as scalar-prefetch arguments (they are
+  traced values inside a ring ``lax.scan``), so causal masking uses global
+  token positions and blocks strictly above the (global) diagonal skip
+  their matmuls via ``pl.when`` — a ring step that is entirely in the
+  masked future costs DMAs but no FLOPs.
 - K/V stay compact under grouped-query attention — the head index map
   divides by ``kv_repeat``.
-- Causal masking uses global token positions; blocks strictly above the
-  diagonal skip their matmuls entirely (``pl.when``), saving ~half the
-  FLOPs.
 
-The public wrapper pads ragged sequence lengths to the block size (padded
-keys are masked out, padded query rows sliced off) and falls back to
-``interpret=True`` off-TPU, which is how the CPU test suite validates it
+The public wrappers pad ragged sequence lengths to the block size (padded
+keys are masked out, padded query rows sliced off) and fall back to
+``interpret=True`` off-TPU, which is how the CPU test suite validates them
 bit-for-bit against the dense oracle.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -41,19 +50,31 @@ _NEG_INF = -1e30
 _LANES = 128  # TPU vector lane count: scratch accumulators are (bq, 128)
 
 
-def _positions(i, j, block_q, block_k):
-    q_pos = i * block_q + jax.lax.broadcasted_iota(
+def _positions(offs_ref, i, j, block_q, block_k):
+    """(global q, global k, local q, local k) position grids."""
+    q_loc = i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
-    k_pos = j * block_k + jax.lax.broadcasted_iota(
+    k_loc = j * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return q_pos, k_pos
+    return offs_ref[0] + q_loc, offs_ref[1] + k_loc, q_loc, k_loc
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale: float, causal: bool, block_q: int, block_k: int,
-                seq_len: int, precision):
+def _live(offs_ref, i, j, block_q, block_k, causal):
+    """False only when block (i, j) lies strictly above the global causal
+    diagonal (then every entry is masked and the matmuls can be skipped)."""
+    if not causal:
+        return j >= 0  # traced True
+    return (
+        offs_ref[1] + j * block_k
+        <= offs_ref[0] + i * block_q + block_q - 1
+    )
+
+
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale: float, causal: bool,
+                block_q: int, block_k: int, kv_len: int, precision):
     i = pl.program_id(2)  # Q block
     j = pl.program_id(3)  # KV block (innermost, sequential)
 
@@ -63,10 +84,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Block (i, j) is live unless it lies strictly above the causal diagonal.
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
-
-    @pl.when(live)
+    @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
     def _attend():
         q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
         k = k_ref[0, 0].astype(jnp.float32)  # (bk, d)
@@ -77,8 +95,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             precision=precision,
         ) * scale  # (bq, bk)
 
-        q_pos, k_pos = _positions(i, j, block_q, block_k)
-        invalid = k_pos >= seq_len  # padded keys
+        q_pos, k_pos, _, k_loc = _positions(offs_ref, i, j, block_q, block_k)
+        invalid = k_loc >= kv_len  # padded keys
         if causal:
             invalid |= k_pos > q_pos
         s = jnp.where(invalid, _NEG_INF, s)
@@ -118,15 +136,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def _recompute_p(q, k, lse, i, j, *, scale, causal, block_q, block_k,
-                 seq_len, precision):
+def _recompute_p(offs_ref, q, k, lse, i, j, *, scale, causal, block_q,
+                 block_k, seq_len, kv_len, precision):
     """p_ij = exp(s_ij - lse_i), zeroed on masked/padded/empty rows."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision,
     ) * scale
-    q_pos, k_pos = _positions(i, j, block_q, block_k)
-    invalid = (k_pos >= seq_len) | (q_pos >= seq_len)
+    q_pos, k_pos, q_loc, k_loc = _positions(offs_ref, i, j, block_q, block_k)
+    invalid = (k_loc >= kv_len) | (q_loc >= seq_len)
     if causal:
         invalid |= k_pos > q_pos
     empty = lse <= _NEG_INF / 2  # (bq,)
@@ -134,9 +152,10 @@ def _recompute_p(q, k, lse, i, j, *, scale, causal, block_q, block_k,
     return jnp.where(invalid | empty[:, None], 0.0, p)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale: float, causal: bool, block_q: int,
-               block_k: int, seq_len: int, precision):
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dlse_ref, dq_ref, dq_acc, *, scale: float, causal: bool,
+               block_q: int, block_k: int, seq_len: int, kv_len: int,
+               precision):
     i = pl.program_id(2)  # Q block
     j = pl.program_id(3)  # KV block (innermost, sequential)
 
@@ -144,24 +163,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
-
-    @pl.when(live)
+    @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
     def _accum():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         p = _recompute_p(
-            q, k, lse_ref[0, 0][:, 0], i, j, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, seq_len=seq_len,
-            precision=precision,
+            offs_ref, q, k, lse_ref[0, 0][:, 0], i, j, scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            seq_len=seq_len, kv_len=kv_len, precision=precision,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )  # (bq, bk)
-        ds = p * (dp - delta_ref[0, 0]) * scale
+        ds = p * (dp - delta_ref[0, 0] + dlse_ref[0, 0]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
@@ -172,9 +189,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_acc, dv_acc, *, scale: float, causal: bool,
-                block_q: int, block_k: int, seq_len: int, precision):
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                causal: bool, block_q: int, block_k: int, seq_len: int,
+                kv_len: int, precision):
     j = pl.program_id(2)  # KV block
     i = pl.program_id(3)  # Q block (innermost, sequential)
 
@@ -183,18 +201,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else (i >= 0)
-
-    @pl.when(live)
+    @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
     def _accum():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         p = _recompute_p(
-            q, k, lse_ref[0, 0][:, 0], i, j, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, seq_len=seq_len,
-            precision=precision,
+            offs_ref, q, k, lse_ref[0, 0][:, 0], i, j, scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            seq_len=seq_len, kv_len=kv_len, precision=precision,
         )  # (bq, bk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -204,7 +220,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
-        ds = p * (dp - delta_ref[0, 0]) * scale
+        ds = p * (dp - delta_ref[0, 0] + dlse_ref[0, 0]) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
@@ -218,13 +234,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
 def _prep(q, k, v, block_q, block_k):
     """Common layout work: (B,T,H,D)→(B,H,T,D), tile-aligned blocks, pads."""
-    B, T, H, D = q.shape
+    B, Tq0, H, D = q.shape
+    Tk0 = k.shape[1]
     tile = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(q.dtype).itemsize, 8)
     align = lambda n: -(-n // tile) * tile  # noqa: E731
-    block_q = min(block_q, align(max(T, 1)))
-    block_k = min(block_k, align(max(T, 1)))
-    pad_q = (-T) % block_q
-    pad_k = (-T) % block_k
+    block_q = min(block_q, align(max(Tq0, 1)))
+    block_k = min(block_k, align(max(Tk0, 1)))
+    pad_q = (-Tq0) % block_q
+    pad_k = (-Tk0) % block_k
     qt = jnp.moveaxis(q, 2, 1)
     kt = jnp.moveaxis(k, 2, 1)
     vt = jnp.moveaxis(v, 2, 1)
@@ -247,57 +264,71 @@ def _precision_for(dtype):
     )
 
 
-def _fwd_impl(q, k, v, causal, kv_repeat, block_q, block_k, interpret):
+def _offsets_arr(q_offset, k_offset):
+    return jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )
+
+
+def _fwd_impl(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
+              interpret):
     assert q.shape[2] == k.shape[2] * kv_repeat, (q.shape, k.shape, kv_repeat)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, T, H, D = q.shape
+    Tkv = k.shape[1]
     qt, kt, vt, block_q, block_k = _prep(q, k, v, block_q, block_k)
     Tq, Tk = qt.shape[2], kt.shape[2]
     precision = _precision_for(q.dtype)
     kernel = functools.partial(
         _fwd_kernel, scale=1.0 / (D**0.5), causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=T, precision=precision,
+        block_k=block_k, kv_len=Tkv, precision=precision,
     )
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, D),
-        lambda b, h, i, j, rep=kv_repeat: (b, h // rep, j, 0),
+        lambda b, h, i, j, *_refs, rep=kv_repeat: (b, h // rep, j, 0),
     )
-    out, lse = pl.pallas_call(
-        kernel,
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, i, j, *_refs: (b, h, i, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, i, j, *_refs: (b, h, i, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(B, H, Tq // block_q, Tk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            kv_spec,
-            kv_spec,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            # Row residual carries a trailing singleton lane dim: TPU block
-            # shapes need the last two dims tile-aligned or whole-array.
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
-        ],
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, row_spec],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom l
             pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
         ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
+        ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(offsets, qt, kt, vt)
     o = out[:, :, :T] if Tq != T else out
-    return jnp.moveaxis(o, 1, 2), (out, lse, interpret, block_q, block_k)
+    return (
+        jnp.moveaxis(o, 1, 2),
+        lse[:, :, :T, 0],
+        (out, lse, interpret, block_q, block_k),
+    )
 
 
-def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, do):
+def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, cts):
+    do, dlse = cts
     # Resolved block sizes / interpret flag ride in the residuals so both
     # passes use identical values (the nondiff args are pre-resolution).
-    q, k, v, out_padded, lse, interpret, block_q, block_k = res
+    q, k, v, offsets, out_padded, lse, interpret, block_q, block_k = res
     B, T, H, D = q.shape
-    Hkv = k.shape[2]
+    Tkv, Hkv = k.shape[1], k.shape[2]
     qt, kt, vt, block_q, block_k = _prep(q, k, v, block_q, block_k)
     Tq, Tk = qt.shape[2], kt.shape[2]
     precision = _precision_for(q.dtype)
@@ -310,71 +341,112 @@ def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, do):
         dot.astype(jnp.float32) * out_padded.astype(jnp.float32), axis=-1,
         keepdims=True,
     )  # (B, H, Tq, 1)
+    # lse cotangent from the caller (zero for plain flash_attention; the
+    # ring combine's weights make it nonzero there).
+    dl = dlse.astype(jnp.float32)[..., None]  # (B, H, T, 1)
+    if Tq != T:
+        dl = jnp.pad(dl, ((0, 0), (0, 0), (0, Tq - T), (0, 0)))
 
     common = dict(
         scale=1.0 / (D**0.5), causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=T, precision=precision,
+        block_k=block_k, seq_len=T, kv_len=Tkv, precision=precision,
     )
-    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, i, j, *_refs: (b, h, i, 0)
+    )
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, D),
-        lambda b, h, i, j, rep=kv_repeat: (b, h // rep, j, 0),
+        lambda b, h, i, j, *_refs, rep=kv_repeat: (b, h // rep, j, 0),
     )
-    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
-
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, i, j, *_refs: (b, h, i, 0)
+    )
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
-        grid=(B, H, Tq // block_q, Tk // block_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, Tq // block_q, Tk // block_k),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                      row_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(offsets, qt, kt, vt, dot, lse, delta, dl)
 
     # dK/dV: grid transposed so the Q axis is innermost (sequential).
-    q_spec_t = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    q_spec_t = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, j, i, *_refs: (b, h, i, 0)
+    )
     kv_spec_t = pl.BlockSpec(
         (1, 1, block_k, D),
-        lambda b, h, j, i, rep=kv_repeat: (b, h // rep, j, 0),
+        lambda b, h, j, i, *_refs, rep=kv_repeat: (b, h // rep, j, 0),
     )
     row_spec_t = pl.BlockSpec(
-        (1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)
+        (1, 1, block_q, 1), lambda b, h, j, i, *_refs: (b, h, i, 0)
     )
-    out_kv_t = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    out_kv_t = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, j, i, *_refs: (b, h, j, 0)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, **common),
-        grid=(B, H, Tk // block_k, Tq // block_q),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
-                  row_spec_t],
-        out_specs=[out_kv_t, out_kv_t],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, Tk // block_k, Tq // block_q),
+            in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                      row_spec_t, row_spec_t],
+            out_specs=[out_kv_t, out_kv_t],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
             jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(offsets, qt, kt, vt, dot, lse, delta, dl)
 
     if Tq != T:
         dq = dq[:, :, :T]
-    if Tk != T:
-        dk = dk[:, :, :T]
-        dv = dv[:, :, :T]
+    if Tk != Tkv:
+        dk = dk[:, :, :Tkv]
+        dv = dv[:, :, :Tkv]
     dq = jnp.moveaxis(dq, 1, 2)
     # Per-Q-head dK/dV collapse onto the compact KV heads (GQA group sum).
     if kv_repeat > 1:
-        dk = dk.reshape(B, Hkv, kv_repeat, T, D).sum(axis=2)
-        dv = dv.reshape(B, Hkv, kv_repeat, T, D).sum(axis=2)
+        dk = dk.reshape(B, Hkv, kv_repeat, Tkv, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, kv_repeat, Tkv, D).sum(axis=2)
     dk = jnp.moveaxis(dk, 1, 2)
     dv = jnp.moveaxis(dv, 1, 2)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    d_offsets = np.zeros((2,), jax.dtypes.float0)  # int arg: zero cotangent
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), d_offsets
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
+                interpret):
+    out, lse, _ = _fwd_impl(
+        q, k, v, offsets, causal, kv_repeat, block_q, block_k, interpret
+    )
+    return out, lse
+
+
+def _vjp_fwd(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
+             interpret):
+    out, lse, (out_padded, lse_padded, ipret, bq, bk) = _fwd_impl(
+        q, k, v, offsets, causal, kv_repeat, block_q, block_k, interpret
+    )
+    return (out, lse), (
+        q, k, v, offsets, out_padded, lse_padded, ipret, bq, bk
+    )
+
+
+_flash_core.defvjp(_vjp_fwd, _bwd_impl)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -392,15 +464,36 @@ def flash_attention(
     accumulation order; fully differentiable (flash backward kernels).
     Off-TPU the kernels run in Pallas interpret mode.
     """
-    out, _ = _fwd_impl(q, k, v, causal, kv_repeat, block_q, block_k, interpret)
+    out, _ = _flash_core(
+        q, k, v, _offsets_arr(0, 0), causal, kv_repeat, block_q, block_k,
+        interpret,
+    )
     return out
 
 
-def _vjp_fwd(q, k, v, causal, kv_repeat, block_q, block_k, interpret):
-    out, (out_padded, lse, ipret, bq, bk) = _fwd_impl(
-        q, k, v, causal, kv_repeat, block_q, block_k, interpret
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset=0,
+    k_offset=0,
+    causal: bool = True,
+    kv_repeat: int = 1,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning (out, logsumexp (B, H, T) fp32).
+
+    ``q_offset`` / ``k_offset`` are GLOBAL token offsets (static or traced
+    ints) added to the local positions for causal masking — ring attention
+    passes its shard offsets here so each ring step masks against global
+    positions.  Rows with every key masked return out == 0 and
+    lse == -1e30; combine partial results with
+    ``lse = logaddexp(lse_a, lse_b)`` and
+    ``out = out_a·exp(lse_a-lse) + out_b·exp(lse_b-lse)``.
+    """
+    return _flash_core(
+        q, k, v, _offsets_arr(q_offset, k_offset), causal, kv_repeat,
+        block_q, block_k, interpret,
     )
-    return out, (q, k, v, out_padded, lse, ipret, bq, bk)
-
-
-flash_attention.defvjp(_vjp_fwd, _bwd_impl)

@@ -64,6 +64,7 @@ def ring_attention_shard(
     axis_name: str,
     causal: bool = True,
     kv_repeat: int = 1,
+    use_flash: bool = False,
 ) -> jax.Array:
     """Per-shard ring attention body (call under ``shard_map``).
 
@@ -72,10 +73,49 @@ def ring_attention_shard(
     circulate ``sp`` times (GQA expansion happens locally per block, so
     ring ICI traffic is 1/kv_repeat of the expanded size); accumulation is
     the flash-attention online softmax generalised across ring steps.
+
+    With ``use_flash`` each ring step's local attend runs the Pallas flash
+    kernel (global-position offsets passed in for causal masking — fully
+    future blocks skip their matmuls in-kernel) and steps merge by the
+    logsumexp identity; otherwise the attend is plain XLA einsums.
     """
     sp = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, T, H, D = q.shape
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    if use_flash:
+        from ddl_tpu.ops import flash_attention_with_lse
+
+        def step(carry, i):
+            o_acc, lse_acc, k_cur, v_cur = carry
+            src = (my_idx - i) % sp
+            o_blk, lse_blk = flash_attention_with_lse(
+                q, k_cur, v_cur, q_offset=my_idx * T, k_offset=src * T,
+                causal=causal, kv_repeat=kv_repeat,
+            )
+            # Merge two normalized partials via logsumexp.  The sentinel
+            # for empty rows is the finite _NEG_INF, so weights must be
+            # explicitly zeroed there (exp of sentinel differences is NOT
+            # negligible: exp(-1e30 - (-1e30 + log2)) = 0.5).
+            lse_new = jnp.logaddexp(lse_acc, lse_blk)  # (B, H, T)
+            safe = jnp.where(lse_new <= _NEG_INF / 2, 0.0, lse_new)
+            w_a = jnp.where(
+                lse_acc <= _NEG_INF / 2, 0.0, jnp.exp(lse_acc - safe)
+            ).transpose(0, 2, 1)[..., None]  # (B, T, H, 1)
+            w_b = jnp.where(
+                lse_blk <= _NEG_INF / 2, 0.0, jnp.exp(lse_blk - safe)
+            ).transpose(0, 2, 1)[..., None]
+            o_new = o_acc * w_a + o_blk.astype(jnp.float32) * w_b
+            k_next = lax.ppermute(k_cur, axis_name, perm)
+            v_next = lax.ppermute(v_cur, axis_name, perm)
+            return (o_new, lse_new, k_next, v_next), None
+
+        o0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full((B, H, T), _NEG_INF, jnp.float32)
+        (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(sp))
+        return o.astype(q.dtype)
+
     scale = 1.0 / (D**0.5)
     q_pos = my_idx * T + jnp.arange(T)
 
@@ -95,7 +135,6 @@ def ring_attention_shard(
             o_acc * alpha.transpose(0, 2, 1)[..., None]
             + o_blk * beta.transpose(0, 2, 1)[..., None]
         )
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return (o_new, m_new, l_new, k_next, v_next), None
@@ -198,7 +237,7 @@ def attention(
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
         return ring_attention(
             q, k, v, mesh, causal=causal, axis=axis, dp_axis=dp_axis,
-            kv_repeat=kv_repeat,
+            kv_repeat=kv_repeat, use_flash=use_flash,
         )
     if mesh is not None:
         return sharded_local_attention(
@@ -236,6 +275,7 @@ def ring_attention(
     axis: str = "sp",
     dp_axis: Optional[str] = "dp",
     kv_repeat: int = 1,
+    use_flash: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention over global arrays.
 
@@ -257,6 +297,7 @@ def ring_attention(
             axis_name=axis,
             causal=causal,
             kv_repeat=kv_repeat,
+            use_flash=use_flash,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
