@@ -28,6 +28,7 @@ __all__ = [
     "ProducerFunctionSkeleton",
     "RunMode",
     "Topology",
+    "Trainer",
     "distributed_dataloader",
 ]
 
@@ -42,4 +43,8 @@ def __getattr__(name: str):
         from ddl_tpu.env import distributed_dataloader
 
         return distributed_dataloader
+    if name == "Trainer":
+        from ddl_tpu.trainer import Trainer
+
+        return Trainer
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -207,6 +207,18 @@ class DistributedDataLoader:
         )
         self.metrics.incr("consumer.windows")
 
+    def fast_forward(self, n_windows: int) -> None:
+        """Discard ``n_windows`` windows without serving them (resume
+        support): producers regenerate their window sequence
+        deterministically from their seeds, so skipping the windows the
+        pre-checkpoint run consumed puts the pipeline at the exact data
+        position where it stopped (one window per epoch — Q7 semantics)."""
+        for _ in range(n_windows):
+            self._acquire_current()
+            self._release_current()
+            self._advance_to_next_producer()
+            self.metrics.incr("consumer.windows_skipped")
+
     def _release_current(self) -> None:
         if self._cur_slot is not None:
             self._ring().release(self._cur_slot)

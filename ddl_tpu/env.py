@@ -223,7 +223,10 @@ def distributed_dataloader(
             topology = detect_topology(n_producers, mode)
             depth = nslots or int(os.environ.get("DDL_TPU_NSLOTS", "2"))
             workers = WorkerSet(topology, depth, shuffler_factory)
-            env = DDL_Env(topology=topology, connection=workers.connection)
+            env = DDL_Env(
+                topology=topology, connection=workers.connection,
+                workers=workers,
+            )
             logger.info(
                 "ddl_tpu: %s mode, %d producer(s), instance %d/%d, %d slot(s)",
                 topology.mode.value,

@@ -1,0 +1,237 @@
+"""High-level Trainer: loader + sharded train step + aux systems in one call.
+
+The reference left the whole consumer side to the user: init
+``torch.distributed`` yourself, write the epoch loop yourself, call
+``mark()`` yourself, no checkpointing, no failure detection (reference
+``tests/run_ddl.py:171-238``, SURVEY §5.3-5.4).  ``Trainer`` composes the
+ddl_tpu equivalents so one object owns the whole training run:
+
+- the producer/consumer topology (``@distributed_dataloader`` role split),
+- the GSPMD train step (``parallel.train.make_train_step``) on a caller
+  mesh,
+- the ``mark()`` protocol, driven automatically around the user-visible
+  epoch loop,
+- checkpoint/resume of BOTH halves (train state via Orbax, the loader's
+  logical clock via ``LoaderCheckpoint``) at epoch boundaries,
+- the producer watchdog and the metrics registry.
+
+The loss function owns the batch layout: it receives exactly the column
+tuple the loader serves (what the reference's user unpacked by hand,
+``run_ddl.py:232``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from ddl_tpu.datasetwrapper import ProducerFunctionSkeleton
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+logger = logging.getLogger("ddl_tpu")
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: Any  # final TrainState
+    losses: List[float]  # per-epoch mean loss
+    epochs_run: int
+    resumed_from_epoch: int
+    metrics: Metrics
+
+
+class Trainer:
+    """Owns one sharded training run fed by the ddl_tpu loader."""
+
+    def __init__(
+        self,
+        loss_fn: Callable[[Any, Any], Any],
+        optimizer: Any,
+        mesh: Any,
+        param_specs: Any,
+        init_params: Any,
+        batch_spec: P = P(("dp",)),
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every_epochs: int = 1,
+        watchdog: bool = True,
+        stall_budget_s: float = 300.0,
+        metrics: Optional[Metrics] = None,
+    ):
+        """``loss_fn(params, batch) -> scalar`` over the loader's batch
+        tuple; ``init_params`` is the initial params pytree (ignored when a
+        checkpoint exists in ``checkpoint_dir``)."""
+        from ddl_tpu.parallel.train import make_train_step
+
+        self.mesh = mesh
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_epochs = max(1, checkpoint_every_epochs)
+        self.watchdog_enabled = watchdog
+        self.stall_budget_s = stall_budget_s
+        self.metrics = metrics or default_metrics()
+        self._init_params = init_params
+        self._batch_spec = batch_spec
+        self._init_fn, self._step_fn = make_train_step(
+            loss_fn, optimizer, mesh, param_specs, batch_spec=batch_spec
+        )
+
+    # -- checkpoint plumbing ----------------------------------------------
+
+    def _loader_ckpt_path(self) -> str:
+        assert self.checkpoint_dir is not None
+        return os.path.join(self.checkpoint_dir, "loader.json")
+
+    def _restore_or_init(self) -> Tuple[Any, int]:
+        """Returns (train state, epoch to start from)."""
+        from ddl_tpu.checkpoint import (
+            LoaderCheckpoint,
+            latest_step,
+            restore_train_state,
+        )
+
+        state = self._init_fn(self._init_params)
+        if self.checkpoint_dir is None or latest_step(self.checkpoint_dir) is None:
+            return state, 0
+        state = restore_train_state(self.checkpoint_dir, like=state)
+        start_epoch = 0
+        if os.path.exists(self._loader_ckpt_path()):
+            start_epoch = LoaderCheckpoint.load(self._loader_ckpt_path()).epoch
+        logger.info(
+            "trainer: resumed step %d / epoch %d from %s",
+            state.step, start_epoch, self.checkpoint_dir,
+        )
+        return state, start_epoch
+
+    def _checkpoint(self, state: Any, loader: Any) -> None:
+        from ddl_tpu.checkpoint import LoaderCheckpoint, save_train_state
+
+        assert self.checkpoint_dir is not None
+        save_train_state(state, self.checkpoint_dir)
+        LoaderCheckpoint.capture(loader).save(self._loader_ckpt_path())
+
+    # -- the run -----------------------------------------------------------
+
+    def fit(
+        self,
+        producer_function: ProducerFunctionSkeleton,
+        batch_size: int,
+        n_epochs: int,
+        n_producers: Optional[int] = None,
+        mode: Optional[str] = None,
+        nslots: int = 2,
+        output: str = "jax",
+        global_shuffle_fraction_exchange: float = 0.0,
+        shuffler_factory: Any = None,
+        loader_kwargs: Optional[dict] = None,
+    ) -> FitResult:
+        """Run the full producer/consumer training job; returns FitResult.
+
+        Under PROCESS/MULTIHOST modes call this from under
+        ``if __name__ == "__main__":`` (multiprocessing spawn re-imports
+        the main module).  Global shuffle needs BOTH knobs: the exchange
+        fraction and a ``shuffler_factory`` (e.g.
+        ``ThreadExchangeShuffler.factory(...)``) — producers only build a
+        shuffler when a factory is given.
+        """
+        from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+        from ddl_tpu.watchdog import Watchdog
+
+        if global_shuffle_fraction_exchange > 0 and shuffler_factory is None:
+            raise ValueError(
+                "global_shuffle_fraction_exchange > 0 requires a "
+                "shuffler_factory (producers build no shuffler without one)"
+            )
+        trainer = self
+
+        @distributed_dataloader(
+            n_producers=n_producers, mode=mode, nslots=nslots,
+            shuffler_factory=shuffler_factory,
+        )
+        def _main(env):
+            state, start_epoch = trainer._restore_or_init()
+            lkw = dict(loader_kwargs or {})
+            if output == "jax" and "sharding" not in lkw:
+                # Batches land directly sharded over the mesh instead of
+                # materialising whole on device 0 and resharding.
+                from ddl_tpu.parallel.train import _named
+
+                lkw["sharding"] = _named(trainer.mesh, trainer._batch_spec)
+            loader = DistributedDataLoader(
+                producer_function,
+                batch_size=batch_size,
+                connection=env.connection,
+                n_epochs=n_epochs,
+                output=output,
+                metrics=trainer.metrics,
+                global_shuffle_fraction_exchange=(
+                    global_shuffle_fraction_exchange
+                ),
+                **lkw,
+            )
+            if start_epoch >= n_epochs:
+                # Nothing left to run (fit re-invoked with fewer epochs
+                # than the checkpoint already completed).
+                logger.info(
+                    "trainer: checkpoint at epoch %d >= n_epochs %d — "
+                    "nothing to do", start_epoch, n_epochs,
+                )
+                loader.shutdown()
+                return FitResult(
+                    state=state, losses=[], epochs_run=0,
+                    resumed_from_epoch=start_epoch, metrics=trainer.metrics,
+                )
+            if start_epoch:
+                from ddl_tpu.checkpoint import LoaderCheckpoint
+
+                ck = LoaderCheckpoint.load(trainer._loader_ckpt_path())
+                # Discard the windows the pre-checkpoint run consumed (one
+                # per epoch): producers regenerate their sequence
+                # deterministically, so resumed epochs see the DATA they
+                # would have seen, not a replay of epoch 0.
+                loader.fast_forward(ck.epoch)
+                ck.apply(loader)
+            wd = None
+            if trainer.watchdog_enabled and env.workers is not None:
+                wd = Watchdog(
+                    env.workers, stall_budget_s=trainer.stall_budget_s
+                ).start()
+            epoch_losses: List[float] = []
+            try:
+                for epoch in range(start_epoch, n_epochs):
+                    batch_losses: List[Any] = []
+                    for batch in loader:
+                        state_new, loss = trainer._step_fn(state, batch)
+                        state = state_new
+                        # Keep losses as device arrays: a float() here
+                        # would block on the step and serialize loading
+                        # against compute, defeating the ring overlap.
+                        batch_losses.append(loss)
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+                    vals = [float(x) for x in batch_losses]
+                    mean = sum(vals) / len(vals) if vals else float("nan")
+                    epoch_losses.append(mean)
+                    logger.info(
+                        "trainer: epoch %d/%d mean loss %.6f (%d batches)",
+                        epoch + 1, n_epochs, mean, len(batch_losses),
+                    )
+                    if (
+                        trainer.checkpoint_dir is not None
+                        and (epoch + 1) % trainer.checkpoint_every_epochs == 0
+                    ):
+                        trainer._checkpoint(state, loader)
+            finally:
+                if wd is not None:
+                    wd.stop()
+            return FitResult(
+                state=state,
+                losses=epoch_losses,
+                epochs_run=n_epochs - start_epoch,
+                resumed_from_epoch=start_epoch,
+                metrics=trainer.metrics,
+            )
+
+        return _main()

@@ -141,6 +141,8 @@ class DDL_Env:
 
     topology: Topology
     connection: Any  # ddl_tpu.transport Connection; Any to avoid cycle
+    workers: Any = None  # ddl_tpu.env.WorkerSet (consumer side); for
+    # liveness monitoring (Watchdog) and abort plumbing
 
     @property
     def is_consumer(self) -> bool:

@@ -74,6 +74,11 @@ class Watchdog:
 
     def check_once(self) -> Optional[str]:
         """One sweep; returns a failure description or None."""
+        rings = self.workers.connection.rings
+        if rings and all(r.is_shutdown() for r in rings):
+            # Clean shutdown in progress: exiting workers are expected,
+            # not failures.
+            return None
         for i, t in enumerate(self.workers.threads):
             if not t.is_alive():
                 return f"producer thread {i + 1} died"
