@@ -75,9 +75,15 @@ class Watchdog:
     def check_once(self) -> Optional[str]:
         """One sweep; returns a failure description or None."""
         rings = self.workers.connection.rings
-        if rings and all(r.is_shutdown() for r in rings):
-            # Clean shutdown in progress: exiting workers are expected,
-            # not failures.
+        # Clean shutdown is initiated ring-by-ring (loader.shutdown() flags
+        # rings sequentially), so a sweep landing mid-teardown may see some
+        # rings flagged and some not while producer threads are already
+        # exiting. Treat ANY shut-down ring as shutdown-in-progress rather
+        # than flagging spurious "producer died" failures. Ring-like doubles
+        # without is_shutdown() are treated as live.
+        if rings and any(
+            getattr(r, "is_shutdown", lambda: False)() for r in rings
+        ):
             return None
         for i, t in enumerate(self.workers.threads):
             if not t.is_alive():
@@ -86,7 +92,7 @@ class Watchdog:
             if p.exitcode is not None and p.exitcode != 0:
                 return f"producer process {i + 1} exited with {p.exitcode}"
         now = time.monotonic()
-        for i, ring in enumerate(self.workers.connection.rings):
+        for i, ring in enumerate(rings):
             st = ring.stats()
             progress = (st["committed"], st["released"])
             if self._last_progress.get(i) != progress:
@@ -106,7 +112,13 @@ class Watchdog:
         # Workers that already exited cleanly (end of run) are expected;
         # only flag failures while the pipeline is supposed to be live.
         while not self._stop.wait(self.poll_interval_s):
-            reason = self.check_once()
+            try:
+                reason = self.check_once()
+            except Exception:
+                # A crashing sweep must never silently disable failure
+                # detection; log and keep monitoring.
+                logger.exception("watchdog: check_once raised; continuing")
+                continue
             if reason is not None:
                 self.failures.append(reason)
                 self.on_failure(reason)

@@ -68,6 +68,9 @@ class _FakeRing:
         self.released = 0.0
         self.down = False
 
+    def is_shutdown(self):
+        return self.down
+
     def stats(self):
         return {"committed": self.committed, "released": self.released,
                 "producer_stall_s": 0.0, "consumer_stall_s": 0.0}
@@ -108,6 +111,47 @@ class TestWatchdog:
         wd.stop()
         assert wd.failures and "no progress" in wd.failures[0]
         assert w.aborted
+
+    def test_shutdown_in_progress_suppresses_failures(self):
+        # Mid-teardown: one of two rings flagged, producer thread already
+        # exited. Must NOT be reported as a failure.
+        r1, r2 = _FakeRing(), _FakeRing()
+        r1.down = True
+        w = _FakeWorkers([r1, r2])
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        w.threads = [t]
+        wd = Watchdog(w, poll_interval_s=0.01)
+        assert wd.check_once() is None
+
+    def test_ring_double_without_is_shutdown_tolerated(self):
+        class _Bare:
+            def stats(self):
+                return {"committed": 1.0, "released": 0.0}
+
+        w = _FakeWorkers([_Bare()])
+        wd = Watchdog(w, poll_interval_s=0.01)
+        assert wd.check_once() is None  # progress pending, nothing dead
+
+    def test_crashing_sweep_does_not_kill_watchdog(self):
+        w = _FakeWorkers([_FakeRing()])
+        wd = Watchdog(w, poll_interval_s=0.01, stall_budget_s=10.0)
+        boom = {"n": 0}
+        real = wd.check_once
+
+        def flaky():
+            boom["n"] += 1
+            if boom["n"] == 1:
+                raise RuntimeError("transient")
+            return real()
+
+        wd.check_once = flaky
+        wd.start()
+        time.sleep(0.1)
+        wd.stop()
+        assert boom["n"] > 1  # survived the first crashing sweep
+        assert not wd.failures
 
     def test_progress_keeps_quiet(self):
         ring = _FakeRing()
