@@ -32,8 +32,9 @@ class LoaderConfig:
     global_shuffle_fraction_exchange: float = 0.0
     exchange_method: str = "sendrecv_replace"
     shuffle_seed: int = 0
-    # consumer output
-    output: str = "torch"
+    # consumer output ("jax" — TPU-native default; the bare
+    # DistributedDataLoader keeps the reference's torch-first default)
+    output: str = "jax"
     # failure detection
     ring_timeout_s: float = 300.0
     stall_budget_s: float = 120.0

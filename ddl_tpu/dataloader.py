@@ -31,10 +31,17 @@ from ddl_tpu.exceptions import DoesNotMatchError
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import ConsumerConnection
 from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer
+from ddl_tpu.utils import for_all_methods, with_logging
 
 logger = logging.getLogger("ddl_tpu")
 
 
+# Rank-tagged DEBUG call tracing on every method, as the reference wrapped
+# its three core classes (reference ``mpi_dataloader.py:106``); the hot
+# per-batch path (``__getitem__`` via dunder skip, ``_host_cols``
+# explicitly) stays quiet, mirroring the reference's ``__getitem__``
+# exclusion (``mpi_dataloader.py:104-106``).
+@for_all_methods(with_logging, exclude=("_host_cols",))
 class DistributedDataLoader:
     """Map-style loader over producer window rings.
 
@@ -128,9 +135,8 @@ class DistributedDataLoader:
     def __len__(self) -> int:
         return self._len
 
-    def __getitem__(self, idx: int) -> Tuple[Any, ...]:
-        # IndexError terminates Python's implicit iteration protocol in the
-        # user's `for` loop (reference mpi_dataloader.py:180-183).
+    def _host_cols(self, idx: int) -> Tuple[np.ndarray, ...]:
+        """Zero-copy column views of batch ``idx`` in the current window."""
         if not isinstance(idx, (int, np.integer)):
             raise ValueError(f"index must be int, got {type(idx)}")
         if idx < 0 or idx >= self._len:
@@ -143,7 +149,12 @@ class DistributedDataLoader:
         start = self.batch_size * idx
         batch = self._cur_array[start : start + self.batch_size]
         self.metrics.incr("consumer.samples", self.batch_size)
-        cols = _split_columns(batch, self.splits_per_producer[self._target])
+        return _split_columns(batch, self.splits_per_producer[self._target])
+
+    def __getitem__(self, idx: int) -> Tuple[Any, ...]:
+        # IndexError terminates Python's implicit iteration protocol in the
+        # user's `for` loop (reference mpi_dataloader.py:180-183).
+        cols = self._host_cols(idx)
         if self.output == "numpy":
             return cols
         if self.output == "torch":
@@ -155,6 +166,28 @@ class DistributedDataLoader:
             return tuple(torch.from_numpy(c) for c in cols)
         assert self._ingestor is not None
         return self._ingestor.put(cols)
+
+    def prefetch(self, depth: int = 2):
+        """Iterate one epoch's device batches with ``depth`` transfers in
+        flight (``output="jax"`` only) — while step k computes, batch k+1
+        is already crossing into HBM (the standard TPU input recipe;
+        VERDICT r2 item 5 wired this into the training path).
+
+        Reads ahead *within the current window*: all ``len(self)`` batches
+        of an epoch live in one window, and the ingestor copies each column
+        out of the slot at enqueue time, so lookahead never outlives the
+        slot.  ``mark()`` stays the caller's job, exactly as with plain
+        iteration.
+        """
+        if self._ingestor is None:
+            raise RuntimeError("prefetch requires output='jax'")
+        from ddl_tpu.ingest import PrefetchIterator
+
+        def host_iter():
+            for idx in range(self._len):
+                yield self._host_cols(idx)
+
+        return PrefetchIterator(host_iter(), self._ingestor, depth)
 
     # -- progress marks ------------------------------------------------------
 
@@ -196,7 +229,13 @@ class DistributedDataLoader:
         self._target = (self._target + 1) % self.n_producers
 
     def _acquire_current(self) -> None:
-        with self.metrics.timed("consumer.wait"):
+        from ddl_tpu.profiling import annotate
+
+        # The annotation makes window-wait stalls visible on the profiler
+        # timeline next to the XLA ops (SURVEY §5.1 TPU-native tracing).
+        with annotate("ddl.window_acquire"), self.metrics.timed(
+            "consumer.wait"
+        ):
             slot = self._ring().acquire_drain(self.timeout_s)
         self._cur_slot = slot
         nbytes = self._ring().slot_payload(slot)

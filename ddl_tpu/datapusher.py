@@ -35,7 +35,7 @@ from ddl_tpu.types import (
     Topology,
     normalize_splits,
 )
-from ddl_tpu.utils import execute_callbacks
+from ddl_tpu.utils import execute_callbacks, for_all_methods, with_logging
 
 logger = logging.getLogger("ddl_tpu")
 
@@ -44,6 +44,10 @@ logger = logging.getLogger("ddl_tpu")
 DEFAULT_NSLOTS = 2
 
 
+# DEBUG call tracing on every method, as the reference did
+# (``for_all_methods(with_logging)``, reference ``datapusher.py:44``);
+# ``_commit_window`` (per-window hot path) stays quiet.
+@for_all_methods(with_logging, exclude=("_commit_window",))
 class DataPusher:
     """One producer worker: handshake, then fill windows until shutdown.
 

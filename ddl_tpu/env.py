@@ -204,6 +204,7 @@ def distributed_dataloader(
     mode: Optional[RunMode | str] = None,
     nslots: Optional[int] = None,
     shuffler_factory: Any = None,
+    config: Any = None,
 ) -> Callable[..., Any]:
     """Decorator running ``func`` as the consumer with producers alongside.
 
@@ -212,10 +213,21 @@ def distributed_dataloader(
     :class:`DDL_Env` (topology + consumer connection) is appended.
     Returns ``func``'s return value after all producers have exited.
 
+    ``config`` (a :class:`ddl_tpu.config.LoaderConfig`) supplies topology
+    defaults — explicit keyword arguments win over it, and both win over
+    the ``DDL_TPU_*`` environment fallbacks inside
+    :func:`detect_topology`.
+
     PROCESS/MULTIHOST modes use ``multiprocessing`` spawn: call the
     decorated main under ``if __name__ == "__main__":`` (standard spawn
     requirement), or the re-imported script will recursively spawn.
     """
+    if config is not None:
+        n_producers = (
+            config.n_producers if n_producers is None else n_producers
+        )
+        mode = config.mode if mode is None else mode
+        nslots = config.nslots if nslots is None else nslots
 
     def deco(f: Callable[..., Any]) -> Callable[..., Any]:
         @functools.wraps(f)

@@ -22,6 +22,7 @@ import queue as queue_mod
 from typing import Any, List, Optional, Sequence
 
 from ddl_tpu.exceptions import StallTimeoutError, TransportError
+from ddl_tpu.utils import for_all_methods, with_logging
 from ddl_tpu.transport.ring import WindowRing
 from ddl_tpu.types import (
     MetaData_Consumer_To_Producer,
@@ -108,6 +109,9 @@ def _resolve_ring(reply: MetaData_Producer_To_Consumer) -> WindowRing:
     raise TransportError(f"producer {reply.producer_idx} sent no ring_ref")
 
 
+# DEBUG call tracing, as the reference wrapped its Connection class
+# (``for_all_methods(with_logging)``, reference ``connection.py:17``).
+@for_all_methods(with_logging)
 class ConsumerConnection:
     """Consumer endpoint: broadcasts metadata, collects replies, owns rings.
 
@@ -192,6 +196,7 @@ class ConsumerConnection:
             ch.close()
 
 
+@for_all_methods(with_logging)
 class ProducerConnection:
     """Producer endpoint: one control channel + this producer's ring."""
 

@@ -13,18 +13,40 @@ test touched devices before conftest import, which pytest guarantees).
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# DDL_TPU_ONCHIP=1 inverts the suite: the real accelerator backend stays
+# active and ONLY tests marked `onchip` run (VERDICT r2 item 3) —
+# everything else assumes the 8-device CPU sim and is deselected.
+ONCHIP = os.environ.get("DDL_TPU_ONCHIP") == "1"
+
+if not ONCHIP:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not ONCHIP:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    skip_onchip = pytest.mark.skip(
+        reason="on-chip test: set DDL_TPU_ONCHIP=1 (needs a real TPU)"
+    )
+    skip_sim = pytest.mark.skip(
+        reason="CPU-sim test: not run under DDL_TPU_ONCHIP=1"
+    )
+    for item in items:
+        if "onchip" in item.keywords:
+            if not ONCHIP:
+                item.add_marker(skip_onchip)
+        elif ONCHIP:
+            item.add_marker(skip_sim)
 
 
 @pytest.fixture

