@@ -119,11 +119,16 @@ try:  # import lazily-guarded so `import bench` works before deps resolve
             # reference tests/run_ddl.py:163-167).
             self._rng.shuffle(my_ary)
 
-except Exception:  # pragma: no cover - only hit on broken installs
+except Exception as _e:  # pragma: no cover - only hit on broken installs
     BenchProducer = None  # type: ignore[assignment]
+    _producer_import_error: Exception = _e
 
 
 def _make_producer():
+    if BenchProducer is None:
+        raise RuntimeError(
+            "ddl_tpu failed to import at bench startup"
+        ) from _producer_import_error
     return BenchProducer()
 
 
