@@ -72,6 +72,19 @@ def _live(offs_ref, i, j, block_q, block_k, causal):
     )
 
 
+def _crosses_diag(offs_ref, i, j, block_q, block_k, causal):
+    """True when block (i, j) straddles the global causal diagonal (some
+    entries masked, some not).  Interior blocks — fully below the diagonal
+    — skip mask construction entirely: the two (bq, bk) position grids,
+    compares, and selects are the kernel's dominant VPU cost after exp."""
+    if not causal:
+        return j < 0  # traced False
+    return (
+        offs_ref[1] + (j + 1) * block_k - 1
+        > offs_ref[0] + i * block_q
+    )
+
+
 def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_ref, l_ref, acc_ref, *, scale: float, causal: bool,
                 block_q: int, block_k: int, kv_len: int, precision):
@@ -84,37 +97,24 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
-    def _attend():
-        # Matmul operands stay in the input dtype (bf16 runs the MXU at
-        # full rate; fp32 would quarter it) — accumulation is fp32 via
-        # preferred_element_type, so only the operands are low-precision.
-        q = q_ref[0, 0]  # (bq, d)
-        k = k_ref[0, 0]  # (bk, d)
+    def _scores():
+        return _block_scores(q_ref, k_ref, scale, precision)  # (bq, bk) f32
+
+    def _update(s):
+        """Online-softmax accumulate of one score block into m/l/acc."""
         v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=precision,
-        ) * scale  # (bq, bk) fp32
-
-        q_pos, k_pos, _, k_loc = _positions(offs_ref, i, j, block_q, block_k)
-        invalid = k_loc >= kv_len  # padded keys
-        if causal:
-            invalid |= k_pos > q_pos
-        s = jnp.where(invalid, _NEG_INF, s)
-
         m_prev = jnp.max(m_ref[:], axis=-1)  # lanes replicated -> any reduce
         l_prev = jnp.max(l_ref[:], axis=-1)
         m_cur = jnp.max(s, axis=-1)
         m_next = jnp.maximum(m_prev, m_cur)
-        # Fully-masked-so-far rows keep m at -inf; zero the exponent shift so
-        # exp() sees finite args, and zero those probabilities explicitly.
+        # Fully-masked-so-far rows keep m at -inf; zero the exponent shift
+        # so exp() sees finite args.  Masked scores are the finite
+        # _NEG_INF, so exp(s - safe_m) underflows to exactly 0 for them —
+        # no explicit zeroing select is needed.
         safe_m = jnp.where(m_next <= _NEG_INF / 2, 0.0, m_next)
         alpha = jnp.exp(jnp.where(m_prev <= _NEG_INF / 2, _NEG_INF,
                                   m_prev - safe_m))
         p = jnp.exp(s - safe_m[:, None])
-        p = jnp.where(invalid, 0.0, p)
 
         l_next = alpha * l_prev + jnp.sum(p, axis=-1)
         # p drops to the input dtype for the MXU (standard flash practice;
@@ -126,6 +126,29 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         )
         m_ref[:] = jnp.broadcast_to(m_next[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_next[:, None], l_ref.shape)
+
+    # Two real branches (pl.when lowers to an scf.if, executed
+    # conditionally — a value-level lax.cond computed both sides):
+    # interior blocks fully below the diagonal with no padded keys skip
+    # mask construction entirely, the dominant VPU cost after exp.
+    live = _live(offs_ref, i, j, block_q, block_k, causal)
+    needs_mask = (
+        _crosses_diag(offs_ref, i, j, block_q, block_k, causal)
+        | ((j + 1) * block_k > kv_len)
+    )
+
+    @pl.when(live & needs_mask)
+    def _attend_masked():
+        s = _scores()
+        q_pos, k_pos, _, k_loc = _positions(offs_ref, i, j, block_q, block_k)
+        invalid = k_loc >= kv_len  # padded keys
+        if causal:
+            invalid |= k_pos > q_pos
+        _update(jnp.where(invalid, _NEG_INF, s))
+
+    @pl.when(live & jnp.logical_not(needs_mask))
+    def _attend_fast():
+        _update(_scores())
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finish():
@@ -141,13 +164,49 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def _recompute_p(offs_ref, q, k, lse, i, j, *, scale, causal, block_q,
-                 block_k, seq_len, kv_len, precision):
-    """p_ij = exp(s_ij - lse_i), zeroed on masked/padded/empty rows."""
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+def _block_scores(q_ref, k_ref, scale, precision):
+    """Scaled q·kᵀ of the current blocks, fp32 accumulation with operands
+    in the input dtype (bf16 runs the MXU at full rate; fp32 would quarter
+    it) — shared by the forward and both backward kernels."""
+    return jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision,
     ) * scale
+
+
+def _bwd_p_dispatch(offs_ref, q_ref, k_ref, lse_ref, i, j, accum, *,
+                    scale, causal, block_q, block_k, seq_len, kv_len,
+                    precision):
+    """Backward-pass block dispatch shared by the dQ and dK/dV kernels:
+    dead blocks skipped, boundary blocks recompute p with full masking,
+    interior blocks use the bare ``exp(s - lse)`` fast path (statement-
+    level ``pl.when`` — real branches, unlike a value-level cond which
+    Mosaic computes on both sides)."""
+    live = _live(offs_ref, i, j, block_q, block_k, causal)
+    needs_mask = _needs_mask_bwd(
+        offs_ref, i, j, block_q, block_k, causal, seq_len, kv_len
+    )
+
+    def scores():
+        return _block_scores(q_ref, k_ref, scale, precision)
+
+    @pl.when(live & needs_mask)
+    def _accum_masked():
+        accum(_p_masked(
+            offs_ref, scores(), lse_ref[0, 0][:, 0], i, j, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=seq_len,
+            kv_len=kv_len,
+        ))
+
+    @pl.when(live & jnp.logical_not(needs_mask))
+    def _accum_fast():
+        accum(jnp.exp(scores() - lse_ref[0, 0][:, 0][:, None]))
+
+
+def _p_masked(offs_ref, s, lse, i, j, *, causal, block_q, block_k,
+              seq_len, kv_len):
+    """p = exp(s - lse) with mask/padding/empty-row handling (the slow,
+    boundary-block path — interior blocks use the bare exp)."""
     q_pos, k_pos, q_loc, k_loc = _positions(offs_ref, i, j, block_q, block_k)
     invalid = (k_loc >= kv_len) | (q_loc >= seq_len)
     if causal:
@@ -155,6 +214,19 @@ def _recompute_p(offs_ref, q, k, lse, i, j, *, scale, causal, block_q,
     empty = lse <= _NEG_INF / 2  # (bq,)
     p = jnp.exp(s - jnp.where(empty, 0.0, lse)[:, None])
     return jnp.where(invalid | empty[:, None], 0.0, p)
+
+
+def _needs_mask_bwd(offs_ref, i, j, block_q, block_k, causal, seq_len,
+                    kv_len):
+    """True unless block (i, j) is interior: fully below the diagonal with
+    no padded keys/queries.  Interior blocks cannot contain masked entries
+    or globally-empty rows (the block itself supplies valid keys), so
+    ``exp(s - lse)`` is exact there and mask construction is skipped."""
+    return (
+        _crosses_diag(offs_ref, i, j, block_q, block_k, causal)
+        | ((j + 1) * block_k > kv_len)
+        | ((i + 1) * block_q > seq_len)
+    )
 
 
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -168,18 +240,10 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
-    def _accum():
-        # Native-dtype MXU operands, fp32 accumulation (see _attend).
-        q = q_ref[0, 0]
+    def _accum(p):
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        p = _recompute_p(
-            offs_ref, q, k, lse_ref[0, 0][:, 0], i, j, scale=scale,
-            causal=causal, block_q=block_q, block_k=block_k,
-            seq_len=seq_len, kv_len=kv_len, precision=precision,
-        )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
@@ -189,6 +253,12 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
+
+    _bwd_p_dispatch(
+        offs_ref, q_ref, k_ref, lse_ref, i, j, _accum, scale=scale,
+        causal=causal, block_q=block_q, block_k=block_k, seq_len=seq_len,
+        kv_len=kv_len, precision=precision,
+    )
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finish():
@@ -207,18 +277,10 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(_live(offs_ref, i, j, block_q, block_k, causal))
-    def _accum():
-        # Native-dtype MXU operands, fp32 accumulation (see _attend).
+    def _accum(p):
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        p = _recompute_p(
-            offs_ref, q, k, lse_ref[0, 0][:, 0], i, j, scale=scale,
-            causal=causal, block_q=block_q, block_k=block_k,
-            seq_len=seq_len, kv_len=kv_len, precision=precision,
-        )  # (bq, bk) fp32
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
@@ -232,6 +294,12 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
+
+    _bwd_p_dispatch(
+        offs_ref, q_ref, k_ref, lse_ref, i, j, _accum, scale=scale,
+        causal=causal, block_q=block_q, block_k=block_k, seq_len=seq_len,
+        kv_len=kv_len, precision=precision,
+    )
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finish():
