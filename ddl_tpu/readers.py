@@ -28,6 +28,23 @@ def _my_shard(n_items: int, producer_idx: int, n_producers: int,
     return np.arange(worker % total, n_items, total)
 
 
+def _glob_my_shards(pattern: str, producer_idx: int, n_producers: int,
+                    instance_idx: int, n_instances: int) -> list:
+    """Glob + strided per-worker shard assignment (shared by every
+    file-shard producer), validating at least one shard per worker."""
+    paths = sorted(glob_mod.glob(pattern))
+    if not paths:
+        raise FileNotFoundError(f"no shards match {pattern!r}")
+    mine = _my_shard(len(paths), producer_idx, n_producers,
+                     instance_idx, n_instances)
+    if len(mine) == 0:
+        raise ValueError(
+            f"{len(paths)} shard(s) matching {pattern!r} is fewer than "
+            f"one per worker ({n_instances * n_producers} workers)"
+        )
+    return [paths[i] for i in mine]
+
+
 class ArrayProducer(ProducerFunctionSkeleton):
     """Serve a host-resident (N, F) array — the ``TensorDataset`` analog.
 
@@ -90,16 +107,10 @@ class FileShardProducer(ProducerFunctionSkeleton):
 
     def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
                 n_instances=1, **kw) -> DataProducerOnInitReturn:
-        paths = sorted(glob_mod.glob(self.pattern))
-        if not paths:
-            raise FileNotFoundError(f"no shards match {self.pattern!r}")
-        mine = _my_shard(len(paths), producer_idx, n_producers,
-                         instance_idx, n_instances)
-        if len(mine) == 0:
-            raise ValueError(
-                f"{len(paths)} shards < {n_instances * n_producers} workers"
-            )
-        self._paths = [paths[i] for i in mine]
+        self._paths = _glob_my_shards(
+            self.pattern, producer_idx, n_producers, instance_idx,
+            n_instances,
+        )
         self._cursor = 0
         self._rng = np.random.default_rng([self.seed, producer_idx])
         first = np.load(self._paths[0])
@@ -170,6 +181,280 @@ class TokenStreamProducer(ProducerFunctionSkeleton):
         for row, seq_idx in enumerate(pick):
             start = int(seq_idx) * self.seq_len
             my_ary[row] = self._tokens[start : start + self.seq_len]
+
+    def post_init(self, my_ary, **kw):
+        self._fill(my_ary)
+
+    def execute_function(self, my_ary, **kw):
+        self._fill(my_ary)
+
+
+class WebDatasetProducer(ProducerFunctionSkeleton):
+    """WebDataset-style tar-shard image reader (BASELINE configs[1-2]).
+
+    Each shard is a ``.tar`` whose members pair by basename, the standard
+    WebDataset/ImageNet layout: ``<key>.jpg`` / ``.jpeg`` / ``.png`` (the
+    image) and ``<key>.cls`` (ascii integer label).  Images decode via
+    PIL, resize to ``(image_size, image_size)`` RGB, scale to [0, 1]
+    float32, and flatten; each window row is ``[pixels..., label]``
+    (splits ``(H*W*3, 1)``).  Shards are assigned to workers by the usual
+    strided rule and read as tar *streams*, sample by sample (only the
+    current sample's files are in memory — a multi-hundred-MB ImageNet
+    shard is never materialised whole), cycling shards forever.
+    """
+
+    _IMG_EXT = (".jpg", ".jpeg", ".png")
+
+    def __init__(self, pattern: str, image_size: int = 32,
+                 window_rows: int = 64):
+        self.pattern = pattern
+        self.image_size = image_size
+        self.window_rows = window_rows
+
+    def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
+                n_instances=1, **kw) -> DataProducerOnInitReturn:
+        try:
+            from PIL import Image  # noqa: F401
+        except ImportError as e:  # pragma: no cover - PIL ships in image
+            raise RuntimeError(
+                "WebDatasetProducer needs Pillow for image decoding"
+            ) from e
+        self._shards = _glob_my_shards(
+            self.pattern, producer_idx, n_producers, instance_idx,
+            n_instances,
+        )
+        self._iter = self._stream_samples()
+        n_px = self.image_size * self.image_size * 3
+        return DataProducerOnInitReturn(
+            nData=self.window_rows,
+            nValues=n_px + 1,
+            shape=(self.window_rows, n_px + 1),
+            splits=(n_px, 1),
+        )
+
+    # -- tar streaming -----------------------------------------------------
+
+    def _stream_samples(self):
+        """Yield (image_bytes, label), streaming tars and cycling forever.
+
+        WebDataset convention keeps a sample's files adjacent, but pairing
+        is done by key so ordering within a key doesn't matter; ``pending``
+        holds only keys whose pair is incomplete.
+        """
+        import tarfile
+
+        shard_i = 0
+        while True:
+            path = self._shards[shard_i % len(self._shards)]
+            shard_i += 1
+            yielded = 0
+            with tarfile.open(path, mode="r|*") as tf:  # streaming read
+                pending: dict = {}
+                for m in tf:
+                    if not m.isfile():
+                        continue
+                    stem, dot, ext = m.name.rpartition(".")
+                    d = pending.setdefault(stem, {})
+                    d[dot + ext.lower()] = tf.extractfile(m).read()
+                    img = next(
+                        (d[e] for e in self._IMG_EXT if e in d), None
+                    )
+                    if img is not None and ".cls" in d:
+                        del pending[stem]
+                        yielded += 1
+                        yield img, int(d[".cls"].decode().strip())
+            if yielded == 0:
+                raise ValueError(
+                    f"shard {path} holds no (image, .cls) pairs"
+                )
+
+    def _decode(self, img_bytes: bytes) -> np.ndarray:
+        import io
+
+        from PIL import Image
+
+        im = Image.open(io.BytesIO(img_bytes)).convert("RGB")
+        if im.size != (self.image_size, self.image_size):
+            im = im.resize((self.image_size, self.image_size))
+        return np.asarray(im, np.float32).reshape(-1) / 255.0
+
+    def _fill(self, my_ary: np.ndarray) -> None:
+        for row in range(self.window_rows):
+            img, label = next(self._iter)
+            my_ary[row, :-1] = self._decode(img)
+            my_ary[row, -1] = float(label)
+
+    def post_init(self, my_ary, **kw):
+        self._fill(my_ary)
+
+    def execute_function(self, my_ary, **kw):
+        self._fill(my_ary)
+
+
+# -- TFRecord / tf.Example (stdlib-only micro parsers) ------------------------
+
+
+def iter_tfrecords(path: str):
+    """Yield raw record payloads from a TFRecord file.
+
+    Framing (TFRecord spec): u64le length, u32 length-crc, payload,
+    u32 payload-crc.  CRCs are not validated (no tensorflow dependency;
+    corrupt files surface as struct errors or bad downstream parses).
+    """
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(12)
+            if len(head) < 12:
+                return
+            (length,) = struct.unpack("<Q", head[:8])
+            payload = f.read(length)
+            if len(payload) < length:
+                return
+            f.read(4)  # payload crc
+            yield payload
+
+
+def _read_varint(buf: bytes, pos: int):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def example_int64_feature(payload: bytes, key: str) -> Optional[np.ndarray]:
+    """Extract an int64-list feature from a serialized tf.Example.
+
+    A micro-decoder for the three nested messages actually involved
+    (Example.features → Features.feature map → Feature.int64_list),
+    stdlib-only — the C4 feed (BASELINE configs[3]) parses without a
+    tensorflow import.  Returns None when ``key`` is absent.
+    """
+
+    def fields(buf):
+        pos = 0
+        while pos < len(buf):
+            tag, pos = _read_varint(buf, pos)
+            field, wire = tag >> 3, tag & 7
+            if wire == 2:  # length-delimited
+                n, pos = _read_varint(buf, pos)
+                yield field, buf[pos : pos + n]
+                pos += n
+            elif wire == 0:  # varint
+                v, pos = _read_varint(buf, pos)
+                yield field, v
+            elif wire == 5:  # 32-bit
+                pos += 4
+            elif wire == 1:  # 64-bit
+                pos += 8
+            else:  # pragma: no cover - malformed input
+                raise ValueError(f"unsupported wire type {wire}")
+
+    for f_ex, features in fields(payload):
+        if f_ex != 1:  # Example.features
+            continue
+        for f_map, entry in fields(features):
+            if f_map != 1:  # Features.feature (map entry)
+                continue
+            k = v = None
+            for f_e, val in fields(entry):
+                if f_e == 1:
+                    k = val.decode()
+                elif f_e == 2:
+                    v = val
+            if k != key or v is None:
+                continue
+            for f_feat, lst in fields(v):
+                if f_feat != 3:  # Feature.int64_list
+                    continue
+                values = []
+                for f_l, packed in fields(lst):
+                    if f_l != 1:
+                        continue
+                    if isinstance(packed, int):  # unpacked varint
+                        values.append(packed)
+                    else:  # packed repeated varints
+                        pos = 0
+                        while pos < len(packed):
+                            v_, pos = _read_varint(packed, pos)
+                            values.append(v_)
+                return np.array(values, np.int64)
+    return None
+
+
+class TFRecordTokenProducer(ProducerFunctionSkeleton):
+    """C4-style tokenized TFRecord stream (BASELINE configs[3]).
+
+    Shard files matching ``pattern`` are assigned per worker; records
+    parse with the stdlib-only framing/Example readers above.  With
+    ``feature_key`` set (default ``"input_ids"``) each record is a
+    tf.Example whose int64-list feature supplies tokens; with
+    ``feature_key=None`` record payloads are raw little-endian int32
+    tokens.  Token streams concatenate and cut into ``seq_len`` rows.
+    """
+
+    def __init__(self, pattern: str, seq_len: int, window_rows: int,
+                 feature_key: Optional[str] = "input_ids"):
+        self.pattern = pattern
+        self.seq_len = seq_len
+        self.window_rows = window_rows
+        self.feature_key = feature_key
+
+    def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
+                n_instances=1, **kw) -> DataProducerOnInitReturn:
+        self._shards = _glob_my_shards(
+            self.pattern, producer_idx, n_producers, instance_idx,
+            n_instances,
+        )
+        self._shard_i = 0
+        self._buf = np.zeros((0,), np.int32)
+        return DataProducerOnInitReturn(
+            nData=self.window_rows,
+            nValues=self.seq_len,
+            shape=(self.window_rows, self.seq_len),
+            splits=(self.seq_len,),
+            dtype=np.int32,
+        )
+
+    def _tokens_from(self, payload: bytes) -> np.ndarray:
+        if self.feature_key is None:
+            return np.frombuffer(payload, "<i4").astype(np.int32)
+        toks = example_int64_feature(payload, self.feature_key)
+        if toks is None:
+            raise ValueError(
+                f"record lacks int64 feature {self.feature_key!r}"
+            )
+        return toks.astype(np.int32)
+
+    def _fill(self, my_ary: np.ndarray) -> None:
+        need = self.window_rows * self.seq_len
+        dry_shards = 0  # shards in a row contributing zero tokens
+        while len(self._buf) < need:
+            path = self._shards[self._shard_i % len(self._shards)]
+            self._shard_i += 1
+            chunks = [self._buf]
+            for payload in iter_tfrecords(path):
+                chunks.append(self._tokens_from(payload))
+            self._buf = np.concatenate(chunks)
+            # Guard on token GROWTH, not record count: shards whose
+            # records all carry empty token lists would otherwise cycle
+            # this loop forever.
+            if len(self._buf) == len(chunks[0]):
+                dry_shards += 1
+                if dry_shards >= len(self._shards):
+                    raise ValueError(
+                        f"no tokens in any of {len(self._shards)} TFRecord "
+                        f"shard(s) (last: {path})"
+                    )
+            else:
+                dry_shards = 0
+        my_ary[:] = self._buf[:need].reshape(self.window_rows, self.seq_len)
+        self._buf = self._buf[need:]
 
     def post_init(self, my_ary, **kw):
         self._fill(my_ary)

@@ -30,17 +30,8 @@ import jax  # noqa: E402
 if not ONCHIP:
     jax.config.update("jax_platforms", "cpu")
 
-import platform  # noqa: E402
-
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
-
-if platform.machine().lower() not in ("x86_64", "amd64", "i686", "i386"):
-    # PyShmRing's TSO gate would refuse to construct on weakly-ordered
-    # ISAs; the suite's pyshm tests exercise protocol logic in-process
-    # (GIL-serialized), where the ordering hazard cannot bite — override
-    # so the suite stays runnable on ARM dev machines.
-    os.environ.setdefault("DDL_TPU_UNSAFE_PY_RING", "1")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,32 @@
+"""Shared ring/ISA guards for the test suite (not a test module).
+
+PyShmRing's counter protocol is only safe on total-store-order ISAs
+(its runtime gate refuses elsewhere — ``transport/shm_ring.py``).  Tests
+fall in two classes:
+
+- *In-process* PyShmRing use (threads in one interpreter) is
+  GIL-serialized, so the ordering hazard cannot bite on any ISA —
+  :func:`allow_inprocess_py_ring` overrides the gate for those.
+- *Cross-process* ring use is only safe with the native (fenced) ring or
+  on a TSO machine — mark those tests with :data:`cross_process_ring`.
+"""
+
+import os
+import platform
+
+import pytest
+
+from ddl_tpu.transport import native_available
+
+TSO = platform.machine().lower() in ("x86_64", "amd64", "i686", "i386")
+
+#: Skip marker for tests that push ring data between real OS processes.
+cross_process_ring = pytest.mark.skipif(
+    not native_available() and not TSO,
+    reason="cross-process shm ring needs the native build or a TSO ISA",
+)
+
+
+def allow_inprocess_py_ring() -> None:
+    """Bypass the TSO gate for in-process (GIL-serialized) PyShmRing use."""
+    os.environ.setdefault("DDL_TPU_UNSAFE_PY_RING", "1")

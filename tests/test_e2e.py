@@ -11,6 +11,8 @@ from typing import Any
 import numpy as np
 import pytest
 
+from ringsupport import cross_process_ring
+
 from ddl_tpu import (
     DataProducerOnInitReturn,
     DistributedDataLoader,
@@ -202,6 +204,7 @@ class TestHandshakeValidation:
             main()  # must return promptly — abort wakes handshaking producers
 
 
+@cross_process_ring
 class TestProcessModeE2E:
     # Deadlock gate: every blocked transport wait is bounded (300 s default
     # ring timeout, 600 s handshake timeout), so a drain deadlock surfaces

@@ -16,6 +16,8 @@ import sys
 
 import pytest
 
+from ringsupport import cross_process_ring
+
 _PROG = os.path.join(os.path.dirname(__file__), "multihost_prog.py")
 _TIMEOUT_S = 420  # 1-CPU box: two jax processes compile serially
 
@@ -27,6 +29,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
+@cross_process_ring
 def test_two_process_jax_distributed():
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)

@@ -12,6 +12,8 @@ import threading
 import numpy as np
 import pytest
 
+from ringsupport import cross_process_ring
+
 from ddl_tpu import (
     DataProducerOnInitReturn,
     DistributedDataLoader,
@@ -151,6 +153,7 @@ class TestResumeWithShuffle:
             assert foreign.size > 0, "no exchanged rows after resume"
 
 
+@cross_process_ring
 class TestProcessModeResume:
     @pytest.mark.slow
     def test_trainer_resume_process_mode(self, tmp_path, rng):
@@ -217,6 +220,7 @@ class CrashingProducer(ProducerFunctionSkeleton):
         my_ary[:] = float(self.n)
 
 
+@cross_process_ring
 class TestWatchdogKillE2E:
     @pytest.mark.slow
     def test_killed_producer_aborts_consumer(self):

@@ -24,6 +24,11 @@ from ddl_tpu.transport import (
     native_available,
     open_shm_ring,
 )
+from ringsupport import TSO, allow_inprocess_py_ring
+
+# The pyshm fixtures below use the ring from threads of THIS process,
+# which is safe on any ISA (see ringsupport).
+allow_inprocess_py_ring()
 
 
 def _ring_factories():
@@ -139,7 +144,20 @@ def _child_producer(name: str, n: int) -> None:
 
 
 class TestCrossProcess:
-    @pytest.mark.parametrize("force_py", [False, True])
+    @pytest.mark.parametrize(
+        "force_py",
+        [
+            False,
+            # Cross-process python ring: TSO machines only (the in-process
+            # override does not cover a real second process).
+            pytest.param(
+                True,
+                marks=pytest.mark.skipif(
+                    not TSO, reason="PyShmRing cross-process needs TSO ISA"
+                ),
+            ),
+        ],
+    )
     def test_spawned_producer_roundtrip(self, force_py, monkeypatch):
         if force_py:
             monkeypatch.setenv("DDL_TPU_FORCE_PY_RING", "1")
