@@ -1,5 +1,5 @@
 """Model zoo for the consumer-side training loops the loader feeds."""
 
-from ddl_tpu.models import llama, moe, pointnet
+from ddl_tpu.models import llama, moe, pointnet, vit
 
-__all__ = ["llama", "moe", "pointnet"]
+__all__ = ["llama", "moe", "pointnet", "vit"]

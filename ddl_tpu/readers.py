@@ -250,17 +250,27 @@ class WebDatasetProducer(ProducerFunctionSkeleton):
             yielded = 0
             with tarfile.open(path, mode="r|*") as tf:  # streaming read
                 pending: dict = {}
+                done: set = set()  # keys already yielded this shard
                 for m in tf:
                     if not m.isfile():
                         continue
                     stem, dot, ext = m.name.rpartition(".")
+                    ext = dot + ext.lower()
+                    # Only the pairing members buffer; .json/.txt/...
+                    # sidecars would otherwise leak (and once a key has
+                    # yielded, trailing members for it are dropped too).
+                    if ext not in self._IMG_EXT and ext != ".cls":
+                        continue
+                    if stem in done:
+                        continue
                     d = pending.setdefault(stem, {})
-                    d[dot + ext.lower()] = tf.extractfile(m).read()
+                    d[ext] = tf.extractfile(m).read()
                     img = next(
                         (d[e] for e in self._IMG_EXT if e in d), None
                     )
                     if img is not None and ".cls" in d:
                         del pending[stem]
+                        done.add(stem)
                         yielded += 1
                         yield img, int(d[".cls"].decode().strip())
             if yielded == 0:
@@ -411,7 +421,7 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
             self.pattern, producer_idx, n_producers, instance_idx,
             n_instances,
         )
-        self._shard_i = 0
+        self._records = self._stream_records()
         self._buf = np.zeros((0,), np.int32)
         return DataProducerOnInitReturn(
             nData=self.window_rows,
@@ -420,6 +430,33 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
             splits=(self.seq_len,),
             dtype=np.int32,
         )
+
+    def _stream_records(self):
+        """Yield token chunks record-by-record, cycling shards forever —
+        memory stays bounded by one record, not one shard, and the first
+        batch is served as soon as enough records have parsed."""
+        shard_i = 0
+        while True:
+            path = self._shards[shard_i % len(self._shards)]
+            shard_i += 1
+            grew = False
+            for payload in iter_tfrecords(path):
+                toks = self._tokens_from(payload)
+                if len(toks):
+                    grew = True
+                    yield toks
+            if not grew:
+                # Track consecutive dry shards (records with zero tokens
+                # or none at all) so an all-empty shard set raises instead
+                # of cycling forever.
+                self._dry_shards = getattr(self, "_dry_shards", 0) + 1
+                if self._dry_shards >= len(self._shards):
+                    raise ValueError(
+                        f"no tokens in any of {len(self._shards)} TFRecord "
+                        f"shard(s) (last: {path})"
+                    )
+            else:
+                self._dry_shards = 0
 
     def _tokens_from(self, payload: bytes) -> np.ndarray:
         if self.feature_key is None:
@@ -433,26 +470,13 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
 
     def _fill(self, my_ary: np.ndarray) -> None:
         need = self.window_rows * self.seq_len
-        dry_shards = 0  # shards in a row contributing zero tokens
-        while len(self._buf) < need:
-            path = self._shards[self._shard_i % len(self._shards)]
-            self._shard_i += 1
-            chunks = [self._buf]
-            for payload in iter_tfrecords(path):
-                chunks.append(self._tokens_from(payload))
-            self._buf = np.concatenate(chunks)
-            # Guard on token GROWTH, not record count: shards whose
-            # records all carry empty token lists would otherwise cycle
-            # this loop forever.
-            if len(self._buf) == len(chunks[0]):
-                dry_shards += 1
-                if dry_shards >= len(self._shards):
-                    raise ValueError(
-                        f"no tokens in any of {len(self._shards)} TFRecord "
-                        f"shard(s) (last: {path})"
-                    )
-            else:
-                dry_shards = 0
+        chunks = [self._buf]
+        have = len(self._buf)
+        while have < need:
+            toks = next(self._records)
+            chunks.append(toks)
+            have += len(toks)
+        self._buf = np.concatenate(chunks) if len(chunks) > 1 else self._buf
         my_ary[:] = self._buf[:need].reshape(self.window_rows, self.seq_len)
         self._buf = self._buf[need:]
 

@@ -1,0 +1,68 @@
+"""Shared test-fixture data generators (not a test module).
+
+Mirror encoders for the stdlib-only readers in ``ddl_tpu.readers``:
+WebDataset-style tar image shards and TFRecord/tf.Example files.
+"""
+
+import io
+import struct
+import tarfile
+
+import numpy as np
+
+
+def write_image_shard(path, keys_labels, size=8):
+    """A WebDataset-style tar shard: <key>.png + <key>.cls per sample."""
+    from PIL import Image
+
+    rng = np.random.default_rng(42)
+    with tarfile.open(path, "w") as tf:
+        for key, label in keys_labels:
+            im = Image.fromarray(
+                rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+            )
+            buf = io.BytesIO()
+            im.save(buf, format="PNG")
+            for name, data in ((f"{key}.png", buf.getvalue()),
+                               (f"{key}.cls", str(label).encode())):
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+
+
+def encode_varint(v):
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def encode_example_int64(key, values):
+    """Serialized tf.Example with one int64-list feature (mirror of
+    readers.example_int64_feature's decoder)."""
+
+    def ld(field, payload):  # length-delimited field
+        return encode_varint((field << 3) | 2) + encode_varint(
+            len(payload)
+        ) + payload
+
+    packed = b"".join(encode_varint(v) for v in values)
+    int64_list = ld(1, packed)
+    feature = ld(3, int64_list)
+    entry = ld(1, key.encode()) + ld(2, feature)
+    features = ld(1, entry)
+    return ld(1, features)
+
+
+def write_tfrecord(path, payloads):
+    """TFRecord framing (crc fields zeroed — readers don't validate)."""
+    with open(path, "wb") as f:
+        for p in payloads:
+            f.write(struct.pack("<Q", len(p)))
+            f.write(b"\x00" * 4)  # length crc
+            f.write(p)
+            f.write(b"\x00" * 4)  # payload crc

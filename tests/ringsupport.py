@@ -5,13 +5,12 @@ PyShmRing's counter protocol is only safe on total-store-order ISAs
 fall in two classes:
 
 - *In-process* PyShmRing use (threads in one interpreter) is
-  GIL-serialized, so the ordering hazard cannot bite on any ISA —
-  :func:`allow_inprocess_py_ring` overrides the gate for those.
+  GIL-serialized, so the ordering hazard cannot bite on any ISA — those
+  tests monkeypatch ``DDL_TPU_UNSAFE_PY_RING=1`` locally.
 - *Cross-process* ring use is only safe with the native (fenced) ring or
   on a TSO machine — mark those tests with :data:`cross_process_ring`.
 """
 
-import os
 import platform
 
 import pytest
@@ -26,7 +25,3 @@ cross_process_ring = pytest.mark.skipif(
     reason="cross-process shm ring needs the native build or a TSO ISA",
 )
 
-
-def allow_inprocess_py_ring() -> None:
-    """Bypass the TSO gate for in-process (GIL-serialized) PyShmRing use."""
-    os.environ.setdefault("DDL_TPU_UNSAFE_PY_RING", "1")

@@ -17,6 +17,7 @@ from ddl_tpu.checkpoint import (
 )
 from ddl_tpu.config import LoaderConfig
 from ddl_tpu.readers import ArrayProducer, FileShardProducer, TokenStreamProducer
+from datagen import encode_example_int64, write_image_shard, write_tfrecord
 from ddl_tpu.watchdog import Watchdog
 
 
@@ -345,71 +346,12 @@ class TestShuffleRoundResume:
         assert sh2._round == 5  # permutation schedule continues
 
 
-def _write_image_shard(path, keys_labels, size=8):
-    import io
-    import tarfile
-
-    from PIL import Image
-
-    rng = np.random.default_rng(42)
-    with tarfile.open(path, "w") as tf:
-        for key, label in keys_labels:
-            im = Image.fromarray(
-                rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
-            )
-            buf = io.BytesIO()
-            im.save(buf, format="PNG")
-            for name, data in ((f"{key}.png", buf.getvalue()),
-                               (f"{key}.cls", str(label).encode())):
-                info = tarfile.TarInfo(name)
-                info.size = len(data)
-                tf.addfile(info, __import__("io").BytesIO(data))
-
-
-def _encode_varint(v):
-    out = b""
-    while True:
-        b7 = v & 0x7F
-        v >>= 7
-        if v:
-            out += bytes([b7 | 0x80])
-        else:
-            return out + bytes([b7])
-
-
-def _encode_example_int64(key, values):
-    """Mirror encoder for readers.example_int64_feature's decoder."""
-
-    def ld(field, payload):  # length-delimited field
-        return _encode_varint((field << 3) | 2) + _encode_varint(
-            len(payload)
-        ) + payload
-
-    packed = b"".join(_encode_varint(v) for v in values)
-    int64_list = ld(1, packed)
-    feature = ld(3, int64_list)
-    entry = ld(1, key.encode()) + ld(2, feature)
-    features = ld(1, entry)
-    return ld(1, features)
-
-
-def _write_tfrecord(path, payloads):
-    import struct
-
-    with open(path, "wb") as f:
-        for p in payloads:
-            f.write(struct.pack("<Q", len(p)))
-            f.write(b"\x00" * 4)  # length crc (not validated)
-            f.write(p)
-            f.write(b"\x00" * 4)  # payload crc
-
-
 class TestWebDatasetProducer:
     def test_image_shards_drain(self, tmp_path):
         from ddl_tpu.readers import WebDatasetProducer
 
         for s in range(2):
-            _write_image_shard(
+            write_image_shard(
                 str(tmp_path / f"shard-{s}.tar"),
                 [(f"s{s}k{i}", s * 10 + i) for i in range(6)],
             )
@@ -444,7 +386,7 @@ class TestTFRecordProducer:
     def test_example_roundtrip(self):
         from ddl_tpu.readers import example_int64_feature
 
-        payload = _encode_example_int64("input_ids", [7, 300, 2, 99999])
+        payload = encode_example_int64("input_ids", [7, 300, 2, 99999])
         got = example_int64_feature(payload, "input_ids")
         assert got.tolist() == [7, 300, 2, 99999]
         assert example_int64_feature(payload, "other") is None
@@ -456,12 +398,12 @@ class TestTFRecordProducer:
         rng = np.random.default_rng(0)
         for s in range(2):
             payloads = [
-                _encode_example_int64(
+                encode_example_int64(
                     "input_ids", rng.integers(0, 1000, 50).tolist()
                 )
                 for _ in range(8)
             ]
-            _write_tfrecord(str(tmp_path / f"c4-{s}.tfrecord"), payloads)
+            write_tfrecord(str(tmp_path / f"c4-{s}.tfrecord"), payloads)
 
         @distributed_dataloader(n_producers=2, mode="thread")
         def main(env):
@@ -489,7 +431,7 @@ class TestTFRecordProducer:
         from ddl_tpu.readers import TFRecordTokenProducer
 
         toks = np.arange(64, dtype="<i4")
-        _write_tfrecord(str(tmp_path / "raw-0.tfrecord"), [toks.tobytes()])
+        write_tfrecord(str(tmp_path / "raw-0.tfrecord"), [toks.tobytes()])
         p = TFRecordTokenProducer(
             str(tmp_path / "raw-*.tfrecord"), seq_len=8, window_rows=4,
             feature_key=None,

@@ -319,3 +319,110 @@ class TestLlama3_8BScale:
         # (128256) appears, and the program contains real matmuls.
         assert "128256" in text
         assert "stablehlo.dot_general" in text
+
+
+class TestViT:
+    """Vision transformer: the image-pipeline model family."""
+
+    def _cfg(self, **kw):
+        from ddl_tpu.models import vit
+
+        base = dict(
+            image_size=16, patch_size=4, d_model=32, n_layers=2, n_heads=2,
+            d_ff=64, n_classes=5, dtype=jnp.float32,
+        )
+        base.update(kw)
+        return vit.ViTConfig(**base)
+
+    def test_forward_shapes_and_finite(self):
+        from ddl_tpu.models import vit
+
+        cfg = self._cfg()
+        params = vit.init_params(cfg, jax.random.key(0))
+        imgs = jax.random.uniform(jax.random.key(1), (3, 16, 16, 3))
+        logits = vit.forward(params, imgs, cfg)
+        assert logits.shape == (3, 5)
+        assert np.isfinite(np.asarray(logits)).all()
+        # Flat pixel rows (the loader layout) give identical results.
+        flat = vit.forward(params, imgs.reshape(3, -1), cfg)
+        np.testing.assert_allclose(np.asarray(flat), np.asarray(logits))
+
+    def test_flash_matches_dense(self):
+        from ddl_tpu.models import vit
+
+        params = vit.init_params(self._cfg(), jax.random.key(0))
+        imgs = jax.random.uniform(jax.random.key(1), (2, 16, 16, 3))
+        dense = vit.forward(params, imgs, self._cfg(attn_impl="dense"))
+        flash = vit.forward(params, imgs, self._cfg(attn_impl="flash"))
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), atol=2e-4, rtol=2e-4
+        )
+
+    def test_learns_on_mesh(self):
+        from ddl_tpu.models import vit
+
+        cfg = self._cfg()
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        init_fn, step_fn = make_train_step(
+            lambda p, b: vit.classification_loss(p, b, cfg),
+            optax.adam(3e-3), mesh, vit.param_specs(cfg),
+            batch_spec=P(("dp",)),
+        )
+        state = init_fn(vit.init_params(cfg, jax.random.key(0)))
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 5, (8, 1)).astype(np.float32)
+        # Label-dependent pixels: learnable signal.
+        pixels = (
+            labels[:, :, None] / 5.0
+            + 0.05 * rng.standard_normal((8, 1, 16 * 16 * 3))
+        ).reshape(8, -1).astype(np.float32)
+        losses = []
+        for _ in range(25):
+            state, loss = step_fn(state, (pixels, labels))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_trains_from_webdataset_loader(self, tmp_path):
+        """The full ImageNet-config story: tar image shards -> loader ->
+        ViT train step (BASELINE configs[1-2])."""
+        from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+        from ddl_tpu.models import vit
+        from ddl_tpu.readers import WebDatasetProducer
+        from datagen import write_image_shard
+
+        for s in range(2):
+            write_image_shard(
+                str(tmp_path / f"train-{s}.tar"),
+                [(f"s{s}k{i}", i % 3) for i in range(8)],
+                size=16,
+            )
+        cfg = self._cfg(n_classes=3)
+        mesh = make_mesh({"dp": 8})
+        init_fn, step_fn = make_train_step(
+            lambda p, b: vit.classification_loss(p, b, cfg),
+            optax.adam(1e-3), mesh, vit.param_specs(cfg),
+            batch_spec=P(("dp",)),
+        )
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                WebDatasetProducer(
+                    str(tmp_path / "train-*.tar"), image_size=16,
+                    window_rows=8,
+                ),
+                batch_size=8, connection=env.connection, n_epochs=2,
+                output="numpy",
+            )
+            state = init_fn(vit.init_params(cfg, jax.random.key(0)))
+            losses = []
+            for _ in range(2):
+                for batch in loader:
+                    state, loss = step_fn(state, batch)
+                    losses.append(float(loss))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return losses
+
+        losses = main()
+        assert losses and all(np.isfinite(l) for l in losses)
