@@ -173,6 +173,11 @@ class DataPusher:
                     "global_shuffle",
                     my_ary=self.my_ary,
                     iteration=self._iteration,
+                    # Exchange waits must observe shutdown: the partner
+                    # instance may already be tearing down and never post
+                    # its half (the rendezvous analog of the reference's
+                    # Waitany-vs-Ibarrier race, connection.py:161-182).
+                    should_abort=self.ring.is_shutdown,
                 )
                 execute_callbacks(
                     self.callbacks,

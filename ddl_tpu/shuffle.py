@@ -24,11 +24,12 @@ string (Q8) — both strategies here are real, dispatched, and tested.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ddl_tpu.exceptions import DDLError
+from ddl_tpu.exceptions import DDLError, ShutdownRequested
 from ddl_tpu.types import Topology
 
 #: Permutation search bound (reference ``shuffle.py:74-79`` used 1000 and
@@ -100,13 +101,31 @@ class _Rendezvous:
             self._boxes[key] = rows
             self._lock.notify_all()
 
-    def take(self, key: Tuple[int, int, int], timeout_s: float = 60.0) -> np.ndarray:
+    def take(self, key: Tuple[int, int, int], timeout_s: float = 60.0,
+             should_abort: Optional[Callable[[], bool]] = None) -> np.ndarray:
+        """Blocking take, interruptible: a peer whose run is shutting down
+        may never post its half of the exchange, so the wait polls
+        ``should_abort`` (e.g. the ring's shutdown flag) and raises
+        :class:`ShutdownRequested` instead of stranding the producer for
+        the full timeout (the §3.5 any-time-cancellability property the
+        ring waits already have)."""
+        deadline = time.monotonic() + timeout_s
         with self._lock:
-            if not self._lock.wait_for(
-                lambda: key in self._boxes, timeout=timeout_s
-            ):
-                raise DDLError(f"exchange rendezvous timed out waiting for {key}")
+            while key not in self._boxes:
+                if should_abort is not None and should_abort():
+                    raise ShutdownRequested()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DDLError(
+                        f"exchange rendezvous timed out waiting for {key}"
+                    )
+                self._lock.wait(timeout=min(0.1, remaining))
             return self._boxes.pop(key)
+
+    def discard(self, key: Tuple[int, int, int]) -> None:
+        """Best-effort removal of a posted box (abort-path cleanup)."""
+        with self._lock:
+            self._boxes.pop(key, None)
 
 
 _default_rendezvous = _Rendezvous()
@@ -141,7 +160,8 @@ class ThreadExchangeShuffler:
         self._rdv = rendezvous or _default_rendezvous
         self._round = 0
 
-    def global_shuffle(self, my_ary: np.ndarray, **kwargs: Any) -> None:
+    def global_shuffle(self, my_ary: np.ndarray, should_abort: Any = None,
+                       **kwargs: Any) -> None:
         n = self.topology.n_instances
         me = self.topology.instance_idx
         if n <= 1 or self.num_exchange < 2:
@@ -155,8 +175,21 @@ class ThreadExchangeShuffler:
             (lane_a, int(p[me]), int(pinv[me]), tag),
             (lane_b, int(pinv[me]), int(p[me]), tag + 1),
         ):
-            self._rdv.put((self.producer_idx, t, dest), my_ary[lane].copy())
-            my_ary[lane] = self._rdv.take((self.producer_idx, t, me))
+            put_key = (self.producer_idx, t, dest)
+            self._rdv.put(put_key, my_ary[lane].copy())
+            try:
+                my_ary[lane] = self._rdv.take(
+                    (self.producer_idx, t, me), should_abort=should_abort
+                )
+            except (ShutdownRequested, DDLError):
+                # The partner never showed (shutdown or timeout): retract
+                # our half so a later run on the same rendezvous cannot
+                # pop this round's stale rows as its own round 0.  (A
+                # producer that CRASHES mid-exchange can still leave a
+                # box behind — pass a fresh _Rendezvous per run where
+                # that matters rather than the module default.)
+                self._rdv.discard(put_key)
+                raise
         self._round += 1
 
     # Factory signature expected by DataPusher's shuffler_factory hook.

@@ -2,6 +2,7 @@
 rendezvous exchange, device collectives on the 8-device CPU mesh."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -246,3 +247,98 @@ class TestEndToEndGlobalShuffle:
         assert np.any(results[1] == 0.0), "instance 1 never saw foreign rows"
         # And conservation: across both, half the rows moved each way.
         assert np.sum(results[0] == 1.0) == np.sum(results[1] == 0.0)
+
+
+class TestRendezvousShutdown:
+    def test_take_aborts_on_shutdown_flag(self):
+        """A producer stranded in the exchange (partner already tearing
+        down) must wake promptly via should_abort, not wait out the full
+        rendezvous timeout — the flake this fixes stranded a producer 60s
+        at phase teardown."""
+        from ddl_tpu.exceptions import ShutdownRequested
+        from ddl_tpu.shuffle import _Rendezvous
+
+        rdv = _Rendezvous()
+        flag = {"down": False}
+        t0 = time.monotonic()
+
+        def aborter():
+            time.sleep(0.15)
+            flag["down"] = True
+
+        threading.Thread(target=aborter, daemon=True).start()
+        with pytest.raises(ShutdownRequested):
+            rdv.take((1, 0, 0), timeout_s=30.0,
+                     should_abort=lambda: flag["down"])
+        assert time.monotonic() - t0 < 5.0  # woke promptly, not at 30s
+
+    def test_pusher_exchange_wait_observes_ring_shutdown(self):
+        """End-to-end: instance 0's producer blocks in the exchange with
+        no partner; flagging its ring shuts the pipeline down cleanly."""
+        from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+        from ddl_tpu.datapusher import DataPusher
+        from ddl_tpu.shuffle import _Rendezvous
+        from ddl_tpu.transport.connection import (
+            ConsumerConnection,
+            ProducerConnection,
+            ThreadChannel,
+        )
+        from ddl_tpu.types import (
+            MetaData_Consumer_To_Producer,
+            RunMode,
+            Topology,
+        )
+
+        class P(ProducerFunctionSkeleton):
+            def on_init(self, **kw):
+                return DataProducerOnInitReturn(
+                    nData=8, nValues=2, shape=(8, 2), splits=(1, 1)
+                )
+
+            def post_init(self, my_ary, **kw):
+                my_ary[:] = 0.0
+
+        topo = Topology(n_instances=2, instance_idx=0, n_producers=1,
+                        mode=RunMode.THREAD)
+        cons_end, prod_end = ThreadChannel.pair()
+        pconn = ProducerConnection(prod_end, 1, cross_process=False)
+        rdv = _Rendezvous()  # private: partner instance never shows up
+
+        def producer():
+            DataPusher(
+                pconn, topo, 1,
+                shuffler_factory=ThreadExchangeShuffler.factory(rdv),
+            ).push_data()
+
+        pt = threading.Thread(target=producer, daemon=True)
+        pt.start()
+        conn = ConsumerConnection([cons_end])
+        conn.send_metadata(MetaData_Consumer_To_Producer(
+            data_producer_function=P(), batch_size=8, n_epochs=1,
+            global_shuffle_fraction_exchange=0.5,
+            exchange_method="sendrecv_replace",
+        ))
+        conn.recv_metadata_as_consumer()
+        conn.attach_rings()
+        time.sleep(0.3)  # let the producer reach the partnerless exchange
+        t0 = time.monotonic()
+        conn.shutdown_operation()
+        pt.join(10)
+        assert not pt.is_alive()
+        assert time.monotonic() - t0 < 5.0  # clean, prompt exit
+        conn.finalize()
+
+    def test_aborted_exchange_retracts_posted_rows(self):
+        """A shuffler whose take aborts must discard its own put so a
+        later run on the same rendezvous can't pop stale rows."""
+        from ddl_tpu.exceptions import ShutdownRequested
+        from ddl_tpu.shuffle import _Rendezvous
+
+        rdv = _Rendezvous()
+        topo = Topology(n_instances=2, instance_idx=0, n_producers=1,
+                        mode=RunMode.THREAD)
+        sh = ThreadExchangeShuffler(topo, 1, num_exchange=4, rendezvous=rdv)
+        ary = np.zeros((8, 2), np.float32)
+        with pytest.raises(ShutdownRequested):
+            sh.global_shuffle(ary, should_abort=lambda: True)
+        assert not rdv._boxes, rdv._boxes  # nothing stale left behind
