@@ -193,10 +193,7 @@ def next_token_loss(
     than a ``[:-1]`` slice — the sequence axis keeps its full length, so it
     stays evenly shardable over ``sp``.
     """
-    B, T = tokens.shape
+    from ddl_tpu.models.losses import next_token_cross_entropy
+
     logits = forward(params, tokens, cfg, mesh)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = (jnp.arange(T) < T - 1).astype(ll.dtype)[None, :]
-    return -jnp.sum(ll * mask) / (B * (T - 1))
+    return next_token_cross_entropy(logits, tokens)

@@ -228,11 +228,8 @@ def next_token_loss(
     mesh: Optional[Any] = None,
 ) -> jax.Array:
     """Cross-entropy + weighted router load-balance loss."""
-    B, T = tokens.shape
+    from ddl_tpu.models.losses import next_token_cross_entropy
+
     logits, aux = forward(params, tokens, cfg, mesh)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    maskv = (jnp.arange(T) < T - 1).astype(ll.dtype)[None, :]
-    ce = -jnp.sum(ll * maskv) / (B * (T - 1))
+    ce = next_token_cross_entropy(logits, tokens)
     return ce + cfg.router_aux_weight * aux

@@ -179,11 +179,11 @@ def classification_loss(
     mesh: Optional[Any] = None,
 ) -> jax.Array:
     """Mean cross-entropy over the loader's ``(pixels, label)`` columns."""
+    from ddl_tpu.models.losses import cross_entropy
+
     pixels, labels = batch[0], batch[1]
     logits = forward(params, pixels, cfg, mesh)
-    labels = labels.reshape(-1).astype(jnp.int32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    return cross_entropy(logits, labels.reshape(-1))
 
 
 def accuracy(
