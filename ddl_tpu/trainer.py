@@ -120,6 +120,61 @@ class Trainer:
         save_train_state(state, self.checkpoint_dir)
         LoaderCheckpoint.capture(loader).save(self._loader_ckpt_path())
 
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(
+        self,
+        producer_function: ProducerFunctionSkeleton,
+        state: Any,
+        metric_fn: Callable[[Any, Any], Any],
+        batch_size: int,
+        n_producers: Optional[int] = None,
+        mode: Optional[str] = None,
+        output: str = "numpy",
+    ) -> float:
+        """One-epoch metric pass over a (held-out) producer's windows.
+
+        Drains exactly one epoch (one window per producer rotation — the
+        Q7 epoch) computing ``metric_fn(params, batch) -> scalar`` per
+        batch and returns the mean.  Uses the same producer/consumer
+        machinery as ``fit`` but runs no optimizer step — e.g. pass
+        ``models.vit.accuracy`` for classification eval.
+        """
+        from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+
+        trainer = self
+
+        @distributed_dataloader(n_producers=n_producers, mode=mode)
+        def _run(env):
+            lkw: dict = {}
+            if output == "jax":
+                # Same sharded-landing optimisation as fit: batches land
+                # distributed over the mesh, not whole on device 0.
+                from ddl_tpu.parallel.train import _named
+
+                lkw["sharding"] = _named(trainer.mesh, trainer._batch_spec)
+            loader = DistributedDataLoader(
+                producer_function,
+                batch_size=batch_size,
+                connection=env.connection,
+                n_epochs=1,
+                output=output,
+                metrics=trainer.metrics,
+                **lkw,
+            )
+            it = loader.prefetch(2) if output == "jax" else loader
+            vals: List[Any] = []
+            for batch in it:
+                # Keep metrics as device arrays; a float() here would
+                # serialise loading against compute (see fit).
+                vals.append(metric_fn(state.params, batch))
+                loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+            fvals = [float(v) for v in vals]
+            return sum(fvals) / len(fvals) if fvals else float("nan")
+
+        return _run()
+
     # -- the run -----------------------------------------------------------
 
     def fit(
