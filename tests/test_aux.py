@@ -440,3 +440,35 @@ class TestTFRecordProducer:
         ary = np.zeros(ret.shape, np.int32)
         p.post_init(my_ary=ary)
         assert ary.ravel().tolist() == list(range(32))
+
+
+class TestProfilingAndBandwidth:
+    def test_trace_writes_profile(self, tmp_path):
+        """profiling.trace captures a jax.profiler trace to the log dir."""
+        import jax.numpy as jnp
+
+        from ddl_tpu.profiling import annotate, maybe_trace, trace
+
+        with trace(str(tmp_path)):
+            with annotate("ddl.test_span"):
+                _ = float(jnp.sum(jnp.ones((8, 8))))
+        produced = list((tmp_path).rglob("*"))
+        assert any(p.is_file() for p in produced), produced
+        # maybe_trace with no dir is a no-op (no error, nothing written).
+        with maybe_trace(None):
+            pass
+
+    def test_h2d_bandwidth_and_utilization(self):
+        from ddl_tpu.ingest import measure_h2d_bandwidth, north_star_report
+        from ddl_tpu.observability import Metrics
+
+        bw = measure_h2d_bandwidth(nbytes=1 << 16, trials=1)
+        assert bw > 0
+        m = Metrics()
+        m.incr("ingest.bytes", 1000.0)
+        rep = north_star_report(m, link_bytes_per_sec=bw)
+        assert rep["link_bytes_per_sec"] == bw
+        # The incr'd bytes must actually flow into the utilization.
+        assert rep["bandwidth_utilization"] > 0.0
+        # Without a denominator the utilization key is absent, not zero.
+        assert "bandwidth_utilization" not in north_star_report(m)
