@@ -61,7 +61,7 @@ def main():
     ap.add_argument("--blocks", action="store_true",
                     help="sweep flash block sizes at T=2048")
     ap.add_argument("--steps", type=int, default=3,
-                    help="timed calls per config (median reported)")
+                    help="timed calls per config (minimum reported)")
     args = ap.parse_args()
 
     dev = jax.devices()[0]
