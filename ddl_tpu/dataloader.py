@@ -189,6 +189,53 @@ class DistributedDataLoader:
 
         return PrefetchIterator(host_iter(), self._ingestor, depth)
 
+    def windows(self):
+        """Stream whole windows into HBM, one per epoch (``output="jax"``).
+
+        The zero-copy ingest path: each window's transfer sources the ring
+        slot directly (no host memcpy anywhere between producer fill and
+        HBM), the slot is released only once the transfer has completed,
+        and the next window's transfer streams while the caller's compute
+        on the current one runs.  This is the TPU analog of the
+        reference's zero-copy shared-window reads
+        (reference ``mpi_dataloader.py:192-193``) extended across the
+        host→device boundary.
+
+        Yields device arrays of shape ``(batches_per_window, batch_size,
+        *features)``.  The caller still calls ``mark(Marker.END_OF_EPOCH)``
+        after each window (Q7: one epoch == one window); batch-level
+        ``__getitem__``/``END_OF_BATCH`` iteration must not be mixed with
+        ``windows()`` inside the same epoch.  Pair with producer functions
+        that set ``inplace_fill`` for a fully copy-free pipeline.
+        """
+        if self._ingestor is None:
+            raise RuntimeError("windows() requires output='jax'")
+        import jax
+
+        # Yield-bounded up front: the generator serves exactly the epochs
+        # left, so exhausting it eagerly (e.g. list()) before the marks
+        # terminates rather than streaming past the run's end.
+        for _ in range(self.n_epochs - self._epoch):
+            if self._finalized:
+                break
+            self._acquire_current()
+            assert self._cur_array is not None
+            nd = self.shapes[self._target]
+            # Ragged tail rows (nData not a batch multiple) are unserved,
+            # exactly as in batch iteration.
+            served = self.batches_per_window * self.batch_size
+            window = self._cur_array[:served].reshape(
+                self.batches_per_window, self.batch_size, *nd[1:]
+            )
+            dev = self._ingestor.put_window(window)
+            # The slot stays ours until the bytes are on device; only then
+            # may the producer overwrite it.
+            jax.block_until_ready(dev)
+            self.metrics.incr("consumer.samples", served)
+            self._release_current()
+            self._advance_to_next_producer()
+            yield dev
+
     # -- progress marks ------------------------------------------------------
 
     def mark(self, marker: Marker) -> None:

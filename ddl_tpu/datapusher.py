@@ -11,6 +11,8 @@ TPU-native differences:
   copy lands in the next free ring slot.  With ``nslots>=2`` the producer
   refills while the consumer drains — the double-buffering the reference
   sketched but never built (reference ``ddl/mpi_dataloader.py:21-28``).
+  Producer functions with ``inplace_fill = True`` skip the private array
+  and write straight into ring slots (zero-copy fill).
 - The callback chain actually runs every callback (SURVEY Q1 fixed), so a
   registered global shuffler really executes.
 - Shutdown arrives as :class:`ShutdownRequested` out of any blocked ring
@@ -105,9 +107,14 @@ class DataPusher:
                 f"{init_ret.nData}",
             )
         self.window_nbytes = int(np.prod(self.shape)) * self.dtype.itemsize
+        self.inplace_fill = bool(
+            getattr(meta.data_producer_function, "inplace_fill", False)
+        )
+        self._fill_slot: Optional[int] = None
 
-        # Private window the user fills; commits copy it into ring slots.
-        self.my_ary = np.zeros(self.shape, dtype=self.dtype)
+        if not self.inplace_fill:
+            # Private window the user fills; commits copy it into ring slots.
+            self.my_ary = np.zeros(self.shape, dtype=self.dtype)
 
         # Global shuffler: registered as an additional callback when the
         # topology and config ask for it (reference datapusher.py:89-108) —
@@ -122,6 +129,17 @@ class DataPusher:
                 init_ret.nData * meta.global_shuffle_fraction_exchange
             )
             if num_exchange > 0:
+                if self.inplace_fill:
+                    # The exchange would operate on nslots-stale slot
+                    # content and its result would then be destroyed by
+                    # the contractually required full rewrite — silently
+                    # wrong data distribution, so reject the combination.
+                    raise DoesNotMatchError(
+                        type(meta.data_producer_function).__name__,
+                        "global shuffle is incompatible with "
+                        "inplace_fill producers (the exchange needs a "
+                        "persistent my_ary; use the default copy fill)",
+                    )
                 self.shuffler = shuffler_factory(
                     topology=topology,
                     producer_idx=producer_idx,
@@ -131,6 +149,11 @@ class DataPusher:
                 self.callbacks.append(self.shuffler)
 
         self.ring = connection.create_ring(nslots, self.window_nbytes)
+        if self.inplace_fill:
+            # Zero-copy fill: the user writes straight into ring slots.
+            # The first slot of a fresh ring is free immediately.
+            self._fill_slot = self.ring.acquire_fill()
+            self.my_ary = self._slot_array(self._fill_slot)
         connection.send_metadata(
             MetaData_Producer_To_Consumer(
                 producer_idx=producer_idx,
@@ -148,18 +171,29 @@ class DataPusher:
 
     # -- hot loop (reference datapusher.py:147-170) ------------------------
 
-    def _commit_window(self) -> None:
-        """Copy ``my_ary`` into the next free slot and publish it."""
-        slot = self.ring.acquire_fill()  # raises ShutdownRequested on stop
-        view = (
+    def _slot_array(self, slot: int) -> np.ndarray:
+        return (
             self.ring.slot_view(slot)[: self.window_nbytes]
             .view(self.dtype)
             .reshape(self.shape)
         )
-        np.copyto(view, self.my_ary)
-        self.ring.commit(slot, self.window_nbytes)
+
+    def _commit_window(self) -> None:
+        """Publish the filled window and stage the next fill target."""
+        if self.inplace_fill:
+            # my_ary IS the slot: publish it, then point my_ary at the
+            # next free slot for the coming refill.
+            assert self._fill_slot is not None
+            self.ring.commit(self._fill_slot, self.window_nbytes)
+        else:
+            slot = self.ring.acquire_fill()  # raises ShutdownRequested on stop
+            np.copyto(self._slot_array(slot), self.my_ary)
+            self.ring.commit(slot, self.window_nbytes)
         self.metrics.incr("producer.windows")
         self.metrics.incr("producer.bytes", self.window_nbytes)
+        if self.inplace_fill:
+            self._fill_slot = self.ring.acquire_fill()
+            self.my_ary = self._slot_array(self._fill_slot)
 
     def push_data(self) -> None:
         execute_callbacks(self.callbacks, "on_push_begin")

@@ -60,7 +60,17 @@ class ProducerFunctionSkeleton(abc.ABC):
 
     All hooks accept ``**kwargs`` so the framework can grow the context it
     passes without breaking user subclasses.
+
+    ``inplace_fill``: when True, ``my_ary`` is a direct view of the next
+    free ring slot rather than a private array, and the commit copy is
+    skipped — the zero-copy fill path (the reference's ``my_ary`` *was*
+    the shared window, reference ``tests/run_ddl.py:152-161``; here that
+    is opt-in because slots rotate).  Contract: ``execute_function`` must
+    fully write ``my_ary`` every call — its prior content is the window
+    from ``nslots`` iterations ago, not the previous one.
     """
+
+    inplace_fill: bool = False
 
     @abc.abstractmethod
     def on_init(self, **kwargs: Any) -> DataProducerOnInitReturn:

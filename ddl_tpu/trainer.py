@@ -74,6 +74,9 @@ class Trainer:
         self.metrics = metrics or default_metrics()
         self._init_params = init_params
         self._batch_spec = batch_spec
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._param_specs = param_specs
         self._init_fn, self._step_fn = make_train_step(
             loss_fn, optimizer, mesh, param_specs, batch_spec=batch_spec
         )
@@ -175,6 +178,70 @@ class Trainer:
 
         return _run()
 
+    # -- window-stream epoch loop -----------------------------------------
+
+    def _fit_windows(
+        self,
+        loader: Any,
+        state: Any,
+        start_epoch: int,
+        n_epochs: int,
+        epoch_losses: List[float],
+    ) -> FitResult:
+        """One multistep scan per streamed window (see ``fit`` docstring).
+
+        The per-epoch loss read-back is deferred by one window so the
+        host sync of scan k never blocks the enqueue of scan k+1 or the
+        stream of window k+2.
+        """
+        from ddl_tpu import Marker
+        from ddl_tpu.parallel.train import make_multistep
+
+        splits = set(loader.splits_per_producer)
+        if len(splits) != 1:
+            raise ValueError(
+                "window_stream requires homogeneous column splits across "
+                f"producers, got {sorted(splits)}"
+            )
+        (col_splits,) = splits
+        _, multi_fn = make_multistep(
+            self._loss_fn, self._optimizer, self.mesh, self._param_specs,
+            batch_spec=self._batch_spec,
+            n_steps=loader.batches_per_window,
+        )
+        pending = None
+        epoch = start_epoch
+        for win in loader.windows():
+            cols, off = [], 0
+            for w in col_splits:
+                cols.append(win[..., off : off + w])
+                off += w
+            state, losses = multi_fn(state, tuple(cols), per_step=True)
+            if pending is not None:
+                epoch_losses.append(float(pending.mean()))
+            pending = losses
+            epoch += 1
+            loader.mark(Marker.END_OF_EPOCH)
+            if (
+                self.checkpoint_dir is not None
+                and epoch % self.checkpoint_every_epochs == 0
+            ):
+                self._checkpoint(state, loader)
+        if pending is not None:
+            epoch_losses.append(float(pending.mean()))
+        for i, mean in enumerate(epoch_losses):
+            logger.info(
+                "trainer: epoch %d/%d mean loss %.6f (windowed)",
+                start_epoch + i + 1, n_epochs, mean,
+            )
+        return FitResult(
+            state=state,
+            losses=epoch_losses,
+            epochs_run=n_epochs - start_epoch,
+            resumed_from_epoch=start_epoch,
+            metrics=self.metrics,
+        )
+
     # -- the run -----------------------------------------------------------
 
     def fit(
@@ -190,6 +257,7 @@ class Trainer:
         shuffler_factory: Any = None,
         loader_kwargs: Optional[dict] = None,
         prefetch_depth: int = 2,
+        window_stream: bool = False,
         config: Any = None,
     ) -> FitResult:
         """Run the full producer/consumer training job; returns FitResult.
@@ -202,6 +270,16 @@ class Trainer:
         knobs are `Trainer` constructor arguments, not read from the
         config here.  With no config, ``batch_size`` and ``n_epochs`` are
         required.
+
+        ``window_stream=True`` (``output="jax"`` only) drives the run off
+        the zero-copy window stream: each epoch-window crosses into HBM as
+        ONE transfer straight out of the ring slot
+        (``DistributedDataLoader.windows``) and all its batches run as ONE
+        jitted ``lax.scan`` of optimizer steps (``make_multistep``,
+        ``per_step=True``) — one dispatch and one transfer per window
+        instead of one of each per batch, with the next window streaming
+        while the scan computes.  The optimizer-step sequence is exactly
+        the per-batch path's, so results match batch-mode ``fit``.
 
         Under PROCESS/MULTIHOST modes call this from under
         ``if __name__ == "__main__":`` (multiprocessing spawn re-imports
@@ -238,6 +316,8 @@ class Trainer:
             )
         nslots = 2 if nslots is None else nslots
         output = "jax" if output is None else output
+        if window_stream and output != "jax":
+            raise ValueError("window_stream requires output='jax'")
         global_shuffle_fraction_exchange = (
             global_shuffle_fraction_exchange or 0.0
         )
@@ -257,10 +337,17 @@ class Trainer:
             lkw = dict(loader_kwargs or {})
             if output == "jax" and "sharding" not in lkw:
                 # Batches land directly sharded over the mesh instead of
-                # materialising whole on device 0 and resharding.
+                # materialising whole on device 0 and resharding.  Window
+                # layout is (batches_per_window, batch, ...), so stream
+                # mode shards one axis deeper.
                 from ddl_tpu.parallel.train import _named
 
-                lkw["sharding"] = _named(trainer.mesh, trainer._batch_spec)
+                spec = (
+                    P(*((None,) + tuple(trainer._batch_spec)))
+                    if window_stream
+                    else trainer._batch_spec
+                )
+                lkw["sharding"] = _named(trainer.mesh, spec)
             loader = DistributedDataLoader(
                 producer_function,
                 batch_size=batch_size,
@@ -301,6 +388,14 @@ class Trainer:
                     env.workers, stall_budget_s=trainer.stall_budget_s
                 ).start()
             epoch_losses: List[float] = []
+            if window_stream:
+                try:
+                    return trainer._fit_windows(
+                        loader, state, start_epoch, n_epochs, epoch_losses
+                    )
+                finally:
+                    if wd is not None:
+                        wd.stop()
             try:
                 for epoch in range(start_epoch, n_epochs):
                     batch_losses: List[Any] = []

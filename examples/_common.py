@@ -1,0 +1,19 @@
+"""Shared example-runner plumbing.
+
+``DDL_EXAMPLE_PLATFORM=cpu`` pins the JAX backend for an example run.
+The env var alone is not enough: the axon PJRT plugin's sitecustomize
+re-exports ``JAX_PLATFORMS`` at interpreter start, so the live config
+must be updated before any device touch (same trick as
+tests/conftest.py).  The test suite sets the knob so examples never
+depend on accelerator/tunnel health.
+"""
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    plat = os.environ.get("DDL_EXAMPLE_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

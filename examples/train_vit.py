@@ -26,6 +26,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import pin_platform_from_env  # noqa: E402
+
+pin_platform_from_env()
+
 IMAGE_SIZE = 16
 N_CLASSES = 4
 SHARDS = 2

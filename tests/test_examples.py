@@ -19,6 +19,10 @@ _EXAMPLES = os.path.join(
 def _run(script: str, *args: str, timeout_s: float = 420.0):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # examples pick their own device layout
+    # Examples must not depend on accelerator/tunnel health in CI: pin
+    # the CPU backend (env var alone is overridden by the axon plugin's
+    # sitecustomize; the examples translate this knob to jax.config).
+    env["DDL_EXAMPLE_PLATFORM"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(_EXAMPLES, script), *args],
         capture_output=True,

@@ -22,6 +22,25 @@ class SeqProducer(ProducerFunctionSkeleton):
         my_ary[:, -1] = np.arange(32)
 
 
+class InplaceSeqProducer(ProducerFunctionSkeleton):
+    """Module-level (picklable for PROCESS mode), zero-copy slot fill."""
+
+    inplace_fill = True
+
+    def on_init(self, producer_idx=0, **kw):
+        self.iteration = 0
+        return DataProducerOnInitReturn(
+            nData=32, nValues=4, shape=(32, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        self.iteration += 1
+        my_ary[:] = self.iteration * 100.0
+
+
 class TestDeviceIngestor:
     def test_put_returns_device_arrays(self):
         import jax
@@ -172,6 +191,155 @@ class TestLoaderPrefetch:
         # exactly the same batches plain epochs saw (4 batches of 8 rows).
         assert plain == pf, (plain, pf)
         assert all(len(ep) == 4 for ep in plain + pf)
+
+    def test_windows_streaming(self):
+        """windows(): whole-window zero-copy streaming, content + rotation
+        + epoch accounting match per-batch iteration semantics."""
+
+        class CountingProducer(ProducerFunctionSkeleton):
+            inplace_fill = True
+
+            def on_init(self, producer_idx=0, **kw):
+                self.idx = producer_idx
+                self.iteration = 0
+                return DataProducerOnInitReturn(
+                    nData=32, nValues=4, shape=(32, 4), splits=(3, 1)
+                )
+
+            def post_init(self, my_ary, **kw):
+                my_ary[:] = self.idx * 1000
+
+            def execute_function(self, my_ary, **kw):
+                # inplace_fill contract: fully rewrite the window.
+                self.iteration += 1
+                my_ary[:] = self.idx * 1000 + self.iteration
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                CountingProducer(), batch_size=8, connection=env.connection,
+                n_epochs=6, output="jax",
+            )
+            tags = []
+            for win in loader.windows():
+                assert win.shape == (4, 8, 4)  # (bpw, batch, values)
+                vals = np.unique(np.asarray(win))
+                assert len(vals) == 1  # each window uniform by design
+                tags.append(float(vals[0]))
+                loader.mark(Marker.END_OF_EPOCH)
+            assert loader.epoch == 6
+            return tags
+
+        tags = main()
+        # Round-robin producers (1-based idx, like the reference's shm
+        # ranks), each window freshly rewritten in place: producer 1
+        # serves 1001,1002,..., producer 2 serves 2001,2002,...
+        assert tags == [
+            1001.0, 2001.0, 1002.0, 2002.0, 1003.0, 2003.0,
+        ], tags
+
+    def test_windows_ragged_tail_unserved(self):
+        """nData not a batch multiple: windows() serves the same batches
+        the per-batch path serves, dropping the ragged tail rows."""
+
+        class RaggedProducer(ProducerFunctionSkeleton):
+            def on_init(self, producer_idx=0, **kw):
+                return DataProducerOnInitReturn(
+                    nData=33, nValues=4, shape=(33, 4), splits=(3, 1)
+                )
+
+            def post_init(self, my_ary, **kw):
+                my_ary[:, -1] = np.arange(33)
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                RaggedProducer(), batch_size=8, connection=env.connection,
+                n_epochs=1, output="jax",
+            )
+            (win,) = list(loader.windows())
+            loader.mark(Marker.END_OF_EPOCH)
+            return np.asarray(win)
+
+        win = main()
+        assert win.shape == (4, 8, 4)
+        np.testing.assert_array_equal(win[..., -1].ravel(), np.arange(32))
+
+    def test_inplace_fill_rejects_global_shuffle(self):
+        """Exchange on nslots-stale slots would be silently wrong data —
+        the producer constructor must reject the combination."""
+        import pytest
+
+        from ddl_tpu.datapusher import DataPusher
+        from ddl_tpu.exceptions import DoesNotMatchError
+        from ddl_tpu.shuffle import ThreadExchangeShuffler
+        from ddl_tpu.transport.connection import (
+            ProducerConnection,
+            ThreadChannel,
+        )
+        from ddl_tpu.types import (
+            MetaData_Consumer_To_Producer,
+            RunMode,
+            Topology,
+        )
+
+        topo = Topology(
+            n_instances=2, instance_idx=0, n_producers=1,
+            mode=RunMode.THREAD,
+        )
+        cons_end, prod_end = ThreadChannel.pair()
+        cons_end.send(
+            MetaData_Consumer_To_Producer(
+                data_producer_function=InplaceSeqProducer(), batch_size=8,
+                n_epochs=1, global_shuffle_fraction_exchange=0.5,
+                exchange_method="sendrecv_replace",
+            )
+        )
+        with pytest.raises(DoesNotMatchError, match="inplace_fill"):
+            DataPusher(
+                ProducerConnection(prod_end, 1, cross_process=False),
+                topo, 1,
+                shuffler_factory=ThreadExchangeShuffler.factory(),
+            )
+
+    def test_windows_requires_jax_output(self):
+        import pytest
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=8, connection=env.connection,
+                n_epochs=1, output="numpy",
+            )
+            with pytest.raises(RuntimeError, match="windows"):
+                next(loader.windows())
+            for _ in loader:
+                loader.mark(Marker.END_OF_BATCH)
+            loader.mark(Marker.END_OF_EPOCH)
+
+        main()
+
+    def test_inplace_fill_process_mode(self):
+        """inplace_fill writes land in shm ring slots across processes."""
+
+        @distributed_dataloader(n_producers=1, mode="process")
+        def main(env):
+            loader = DistributedDataLoader(
+                InplaceSeqProducer(), batch_size=8,
+                connection=env.connection, n_epochs=2, output="numpy",
+            )
+            seen = []
+            for _ in range(2):
+                for x, y in loader:
+                    seen.append(float(y[0, 0]))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return seen
+
+        seen = main()
+        # Window 0: iteration 1 tags batches 1.x; window 1: iteration 2.
+        assert seen == [100.0, 100.0, 100.0, 100.0,
+                        200.0, 200.0, 200.0, 200.0], seen
 
     def test_prefetch_requires_jax_output(self):
         import pytest

@@ -79,6 +79,61 @@ def test_fit_jax_output(rng):
     assert all(np.isfinite(l) for l in res.losses)
 
 
+def test_fit_window_stream_matches_batch_mode(rng):
+    """window_stream runs the same optimizer-step sequence as the batch
+    path: same producers, same seeds -> same final params and losses."""
+    seed = rng.integers(1 << 30)
+    _, t_batch = _make_trainer()
+    rb = t_batch.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=3,
+        n_producers=2, mode="thread", output="jax",
+    )
+    _, t_win = _make_trainer()
+    rw = t_win.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=3,
+        n_producers=2, mode="thread", output="jax", window_stream=True,
+    )
+    assert rw.epochs_run == 3 and len(rw.losses) == 3
+    np.testing.assert_allclose(rw.losses, rb.losses, rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(rw.state.params), jax.tree.leaves(rb.state.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+    assert rw.state.step == rb.state.step
+
+
+def test_fit_window_stream_checkpoint_resume(rng, tmp_path):
+    """Resume works at window (== epoch) granularity in stream mode."""
+    seed = 1234
+    _, t1 = _make_trainer(tmp_path)
+    t1.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=2,
+        n_producers=2, mode="thread", output="jax", window_stream=True,
+    )
+    _, t2 = _make_trainer(tmp_path)
+    r2 = t2.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=4,
+        n_producers=2, mode="thread", output="jax", window_stream=True,
+    )
+    assert r2.resumed_from_epoch == 2 and r2.epochs_run == 2
+    assert all(np.isfinite(l) for l in r2.losses)
+
+    # The resumed run must land where an uninterrupted run lands.
+    _, t3 = _make_trainer()
+    r3 = t3.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=4,
+        n_producers=2, mode="thread", output="jax", window_stream=True,
+    )
+    for a, b in zip(
+        jax.tree.leaves(r2.state.params), jax.tree.leaves(r3.state.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_resume_continues_data_not_replay(tmp_path):
     """Resumed epochs must see the windows AFTER the checkpoint, not a
     replay of epoch 0 (producers regenerate deterministically; the
