@@ -41,7 +41,7 @@ logger = logging.getLogger("ddl_tpu")
 # per-batch path (``__getitem__`` via dunder skip, ``_host_cols``
 # explicitly) stays quiet, mirroring the reference's ``__getitem__``
 # exclusion (``mpi_dataloader.py:104-106``).
-@for_all_methods(with_logging, exclude=("_host_cols",))
+@for_all_methods(with_logging, exclude=("_host_cols", "_host_batch"))
 class DistributedDataLoader:
     """Map-style loader over producer window rings.
 
@@ -135,8 +135,8 @@ class DistributedDataLoader:
     def __len__(self) -> int:
         return self._len
 
-    def _host_cols(self, idx: int) -> Tuple[np.ndarray, ...]:
-        """Zero-copy column views of batch ``idx`` in the current window."""
+    def _host_batch(self, idx: int) -> np.ndarray:
+        """Zero-copy view of batch ``idx`` in the current window."""
         if not isinstance(idx, (int, np.integer)):
             raise ValueError(f"index must be int, got {type(idx)}")
         if idx < 0 or idx >= self._len:
@@ -149,23 +149,33 @@ class DistributedDataLoader:
         start = self.batch_size * idx
         batch = self._cur_array[start : start + self.batch_size]
         self.metrics.incr("consumer.samples", self.batch_size)
-        return _split_columns(batch, self.splits_per_producer[self._target])
+        return batch
+
+    def _host_cols(self, idx: int) -> Tuple[np.ndarray, ...]:
+        """Zero-copy column views of batch ``idx`` in the current window."""
+        return _split_columns(
+            self._host_batch(idx), self.splits_per_producer[self._target]
+        )
 
     def __getitem__(self, idx: int) -> Tuple[Any, ...]:
         # IndexError terminates Python's implicit iteration protocol in the
         # user's `for` loop (reference mpi_dataloader.py:180-183).
+        if self.output == "jax":
+            # One transfer per batch, column split ON device (narrow
+            # columns otherwise pay the link's fixed per-transfer cost).
+            assert self._ingestor is not None
+            return self._ingestor.put_batch(
+                self._host_batch(idx), self.splits_per_producer[self._target]
+            )
         cols = self._host_cols(idx)
         if self.output == "numpy":
             return cols
-        if self.output == "torch":
-            import torch
+        # torch.from_numpy is zero-copy over the ring slot, exactly as
+        # the reference's view over the MPI shared window
+        # (mpi_dataloader.py:192-193).
+        import torch
 
-            # torch.from_numpy is zero-copy over the ring slot, exactly as
-            # the reference's view over the MPI shared window
-            # (mpi_dataloader.py:192-193).
-            return tuple(torch.from_numpy(c) for c in cols)
-        assert self._ingestor is not None
-        return self._ingestor.put(cols)
+        return tuple(torch.from_numpy(c) for c in cols)
 
     def prefetch(self, depth: int = 2):
         """Iterate one epoch's device batches with ``depth`` transfers in
@@ -183,11 +193,16 @@ class DistributedDataLoader:
             raise RuntimeError("prefetch requires output='jax'")
         from ddl_tpu.ingest import PrefetchIterator
 
+        splits = self.splits_per_producer[self._target]
+
         def host_iter():
             for idx in range(self._len):
-                yield self._host_cols(idx)
+                yield self._host_batch(idx)
 
-        return PrefetchIterator(host_iter(), self._ingestor, depth)
+        return PrefetchIterator(
+            host_iter(), self._ingestor, depth,
+            put=lambda b: self._ingestor.put_batch(b, splits),
+        )
 
     def windows(self):
         """Stream whole windows into HBM, one per epoch (``output="jax"``).

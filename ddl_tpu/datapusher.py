@@ -49,7 +49,7 @@ DEFAULT_NSLOTS = 2
 # DEBUG call tracing on every method, as the reference did
 # (``for_all_methods(with_logging)``, reference ``datapusher.py:44``);
 # ``_commit_window`` (per-window hot path) stays quiet.
-@for_all_methods(with_logging, exclude=("_commit_window",))
+@for_all_methods(with_logging, exclude=("_commit_window", "_slot_array"))
 class DataPusher:
     """One producer worker: handshake, then fill windows until shutdown.
 

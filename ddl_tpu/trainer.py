@@ -214,7 +214,10 @@ class Trainer:
         for win in loader.windows():
             cols, off = [], 0
             for w in col_splits:
-                cols.append(win[..., off : off + w])
+                # Axis 2 is the first feature axis of the (bpw, batch,
+                # *features) window — the axis every batch-path split
+                # uses (_split_columns slices batch[:, off:off+w]).
+                cols.append(win[:, :, off : off + w])
                 off += w
             state, losses = multi_fn(state, tuple(cols), per_step=True)
             if pending is not None:

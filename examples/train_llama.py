@@ -107,6 +107,10 @@ def main(mode: str = "thread") -> int:
     result = trainer.fit(
         TokenStreamProducer(token_file, SEQ_LEN, WINDOW_ROWS),
         config=cfg,
+        # The recommended TPU path: one zero-copy transfer per window, one
+        # jitted scan of optimizer steps per window (numerically identical
+        # to per-batch fit — tests/test_trainer.py proves equivalence).
+        window_stream=True,
     )
     print("epoch losses:", [round(l, 4) for l in result.losses])
     ok = (

@@ -104,6 +104,48 @@ def test_fit_window_stream_matches_batch_mode(rng):
     assert rw.state.step == rb.state.step
 
 
+def test_fit_window_stream_3d_columns_match_batch_mode(rng):
+    """Column splits act on the FIRST feature axis for >2-D windows in
+    stream mode, exactly as the batch path slices them."""
+    import optax
+
+    from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+    from ddl_tpu.parallel.mesh import make_mesh
+
+    class Cube(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            self._rng = np.random.default_rng(producer_idx)
+            return DataProducerOnInitReturn(
+                nData=32, nValues=6, shape=(32, 6, 4), splits=(5, 1)
+            )
+
+        def post_init(self, my_ary, **kw):
+            my_ary[:] = self._rng.random(my_ary.shape)
+
+    def loss_fn(p, b):
+        x, y = b  # (B, 5, 4), (B, 1, 4)
+        import jax.numpy as jnp
+
+        assert x.shape[1:] == (5, 4) and y.shape[1:] == (1, 4)
+        return jnp.mean((x.mean(axis=(1, 2)) - p["w"] * y.mean(axis=(1, 2)))
+                        ** 2)
+
+    def mk():
+        return Trainer(
+            loss_fn=loss_fn, optimizer=optax.adam(1e-2),
+            mesh=make_mesh({"dp": 8}),
+            param_specs={"w": P()},
+            init_params={"w": np.float32(0.0)},
+            batch_spec=P(("dp",)), watchdog=False,
+        )
+
+    rb = mk().fit(Cube(), batch_size=8, n_epochs=2, n_producers=1,
+                  mode="thread", output="jax")
+    rw = mk().fit(Cube(), batch_size=8, n_epochs=2, n_producers=1,
+                  mode="thread", output="jax", window_stream=True)
+    np.testing.assert_allclose(rw.losses, rb.losses, rtol=1e-5)
+
+
 def test_fit_window_stream_checkpoint_resume(rng, tmp_path):
     """Resume works at window (== epoch) granularity in stream mode."""
     seed = 1234
