@@ -35,6 +35,8 @@ class LoaderConfig:
     # consumer output ("jax" — TPU-native default; the bare
     # DistributedDataLoader keeps the reference's torch-first default)
     output: str = "jax"
+    # zero-copy window streaming (Trainer.fit window_stream; jax output)
+    window_stream: bool = False
     # failure detection
     ring_timeout_s: float = 300.0
     stall_budget_s: float = 120.0

@@ -64,7 +64,12 @@ class DataPusher:
         nslots: int = DEFAULT_NSLOTS,
         metrics: Optional[Metrics] = None,
         shuffler_factory: Any = None,
+        rejoin_ring: Any = None,
     ):
+        """``rejoin_ring`` (elastic recovery): attach to a predecessor's
+        surviving ring (shm name or in-process ring object) instead of
+        creating one, and fast-forward the producer function to the data
+        position the ring's committed count records."""
         self.connection = connection
         self.topology = topology
         self.producer_idx = producer_idx
@@ -120,6 +125,18 @@ class DataPusher:
         # topology and config ask for it (reference datapusher.py:89-108) —
         # and unlike the reference, it will actually run (Q1 fixed).
         self.shuffler = None
+        if rejoin_ring is not None and (
+            topology.n_instances > 1
+            and meta.global_shuffle_fraction_exchange > 0.0
+            and shuffler_factory is not None
+        ):
+            # The exchange schedule of the OTHER instances' pushers has
+            # advanced past the replay; a respawned pusher cannot rejoin
+            # it consistently.
+            raise DoesNotMatchError(
+                producer_idx,
+                "elastic respawn is not supported with global shuffle",
+            )
         if (
             topology.n_instances > 1
             and meta.global_shuffle_fraction_exchange > 0.0
@@ -148,10 +165,14 @@ class DataPusher:
                 )
                 self.callbacks.append(self.shuffler)
 
-        self.ring = connection.create_ring(nslots, self.window_nbytes)
+        if rejoin_ring is not None:
+            self.ring = connection.attach_ring(rejoin_ring)
+        else:
+            self.ring = connection.create_ring(nslots, self.window_nbytes)
         if self.inplace_fill:
             # Zero-copy fill: the user writes straight into ring slots.
-            # The first slot of a fresh ring is free immediately.
+            # (On a fresh ring the first slot is free immediately; on a
+            # rejoined ring this waits for a free slot like any fill.)
             self._fill_slot = self.ring.acquire_fill()
             self.my_ary = self._slot_array(self._fill_slot)
         connection.send_metadata(
@@ -168,6 +189,23 @@ class DataPusher:
 
         # First fill (reference datapusher.py:113-119).
         execute_callbacks(self.callbacks, "post_init", my_ary=self.my_ary)
+
+        if rejoin_ring is not None:
+            # Replay to the predecessor's data position: the ring's
+            # committed count IS the number of windows already published
+            # (a death between data-write and commit re-publishes that
+            # window — the consumer never saw it).
+            done = int(self.ring.stats()["committed"])
+            if done:
+                execute_callbacks(
+                    self.callbacks, "fast_forward", n=done,
+                    my_ary=self.my_ary,
+                )
+            self._iteration = done
+            logger.info(
+                "producer %d: rejoined ring at window %d",
+                producer_idx, done,
+            )
 
     # -- hot loop (reference datapusher.py:147-170) ------------------------
 
@@ -197,6 +235,7 @@ class DataPusher:
 
     def push_data(self) -> None:
         execute_callbacks(self.callbacks, "on_push_begin")
+        clean = False
         try:
             while True:
                 # Order matches the reference loop (datapusher.py:152-166):
@@ -225,6 +264,7 @@ class DataPusher:
                 )
                 self._iteration += 1
         except ShutdownRequested:
+            clean = True
             logger.debug(
                 "producer %d: shutdown after %d windows",
                 self.producer_idx,
@@ -232,7 +272,11 @@ class DataPusher:
             )
         finally:
             execute_callbacks(self.callbacks, "on_push_end")
-            self._finalize()
+            self._finalize(clean=clean)
 
-    def _finalize(self) -> None:
-        self.connection.finalize()
+    def _finalize(self, clean: bool = True) -> None:
+        # A CRASHING producer must leave the shm ring linked: elastic
+        # recovery (WorkerSet.respawn) attaches a replacement to it by
+        # name.  Only a clean shutdown removes the name; the consumer's
+        # finalize is the backstop for crashed-and-never-respawned rings.
+        self.connection.finalize(unlink=clean)

@@ -81,3 +81,15 @@ class ProducerFunctionSkeleton(abc.ABC):
 
     def execute_function(self, **kwargs: Any) -> None:
         """Refill/refresh the window before each handoff. Default: no-op."""
+
+    def fast_forward(self, n: int, **kwargs: Any) -> None:
+        """Advance the producer's data position by ``n`` windows without
+        publishing them — elastic recovery replays a respawned worker to
+        where its predecessor died.  Default: ``n`` ordinary
+        ``execute_function`` calls with the same kwargs the hot loop
+        passes (``my_ary`` plus the per-call ``iteration``), which is
+        exact for any producer whose state advances only through that
+        hook (seeded shuffles, stream cursors).  Producers with cheaper
+        position arithmetic (e.g. a file offset) should override."""
+        for i in range(n):
+            self.execute_function(iteration=i, **kwargs)

@@ -78,6 +78,7 @@ def _producer_main(
     producer_idx: int,
     nslots: int,
     shuffler_factory: Any = None,
+    rejoin_ring: Any = None,
 ) -> None:
     """Body of one producer worker (thread or process)."""
     from ddl_tpu.datapusher import DataPusher
@@ -89,10 +90,16 @@ def _producer_main(
             producer_idx,
             nslots=nslots,
             shuffler_factory=shuffler_factory,
+            rejoin_ring=rejoin_ring,
         )
-    except TransportError:
+    except TransportError as te:
         # Consumer aborted before/during handshake (ABORT sentinel arrives
-        # as non-metadata). Nothing to clean up beyond the channel.
+        # as non-metadata). Nothing to clean up beyond the channel.  The
+        # exception text still goes to DEBUG — a swallowed transport
+        # failure that is NOT an abort (e.g. a failed ring attach) must be
+        # diagnosable from producer logs.
+        logger.debug("producer %d: handshake transport end: %s",
+                     producer_idx, te)
         conn.channel.close()
         return
     except Exception as e:
@@ -116,7 +123,18 @@ def _producer_main(
                 pass
         logger.exception("producer %d failed during handshake", producer_idx)
         return
-    pusher.push_data()
+    try:
+        pusher.push_data()
+    except Exception:
+        # A crash in the user's refill loop: log it here (instead of an
+        # unhandled-thread traceback) and surface it to the watchdog —
+        # dead thread for THREAD mode, nonzero exit for PROCESS mode —
+        # which aborts or respawns per its policy.
+        logger.exception(
+            "producer %d crashed in the push loop", producer_idx
+        )
+        if conn.cross_process:
+            raise SystemExit(1)
 
 
 def _process_entry(
@@ -125,12 +143,15 @@ def _process_entry(
     producer_idx: int,
     nslots: int,
     shuffler_factory: Any = None,
+    rejoin_ring: Any = None,
 ) -> None:
     """Top-level spawn target (must be importable for pickling)."""
     conn = ProducerConnection(
         PipeChannel(pipe_end), producer_idx, cross_process=True
     )
-    _producer_main(conn, topology, producer_idx, nslots, shuffler_factory)
+    _producer_main(
+        conn, topology, producer_idx, nslots, shuffler_factory, rejoin_ring
+    )
 
 
 class WorkerSet:
@@ -139,6 +160,8 @@ class WorkerSet:
     def __init__(self, topology: Topology, nslots: int,
                  shuffler_factory: Any = None):
         self.topology = topology
+        self.nslots = nslots
+        self.shuffler_factory = shuffler_factory
         self.threads: List[threading.Thread] = []
         self.processes: List[Any] = []
         channels = []
@@ -178,6 +201,81 @@ class WorkerSet:
                 child_end.close()
                 self.processes.append(p)
         self.connection = ConsumerConnection(channels)
+
+    def respawn(self, producer_idx: int) -> None:
+        """Replace a dead producer with a fresh worker that rejoins the
+        surviving ring (elastic recovery — the reference had none,
+        SURVEY §5.3: a lost rank deadlocked the job).
+
+        The replacement re-handshakes over a new channel, attaches to the
+        predecessor's ring, and fast-forwards its producer function to
+        the data position the ring's committed count records — the
+        consumer's drain loop never notices beyond the stall.
+        """
+        i = producer_idx - 1
+        if not (0 <= i < self.topology.n_producers):
+            raise ValueError(f"no producer {producer_idx}")
+        ring_ref = getattr(self.connection.replies[i], "ring_ref", None)
+        if ring_ref is None:
+            raise TransportError(
+                f"producer {producer_idx} never completed its first "
+                "handshake; nothing to rejoin"
+            )
+        if self.topology.mode is RunMode.THREAD:
+            if self.threads[i].is_alive():
+                # A hung thread cannot be killed; a second producer on the
+                # same SPSC ring would corrupt it.
+                raise TransportError(
+                    f"producer thread {producer_idx} is still alive; "
+                    "only dead thread producers can be respawned"
+                )
+            consumer_end, producer_end = ThreadChannel.pair()
+            conn = ProducerConnection(
+                producer_end, producer_idx, cross_process=False
+            )
+            t = threading.Thread(
+                target=_producer_main,
+                args=(conn, self.topology, producer_idx, self.nslots,
+                      self.shuffler_factory, ring_ref),
+                name=f"ddl-producer-{producer_idx}-respawn",
+                daemon=True,
+            )
+            t.start()
+            self.threads[i] = t
+            new_ch: Any = consumer_end
+        else:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            old = self.processes[i]
+            if old.is_alive():  # stalled rather than dead: replace it
+                old.terminate()
+                old.join(10)
+                if old.is_alive():
+                    old.kill()
+                    old.join(10)
+                if old.is_alive():
+                    # Unkillable (e.g. blocked in an uninterruptible
+                    # syscall): a second producer on the same SPSC ring
+                    # would corrupt it.
+                    raise TransportError(
+                        f"producer process {producer_idx} survived "
+                        "SIGKILL; cannot safely attach a replacement"
+                    )
+            parent_end, child_end = mp.Pipe(duplex=True)
+            p = ctx.Process(
+                target=_process_entry,
+                args=(child_end, self.topology, producer_idx, self.nslots,
+                      self.shuffler_factory, ring_ref),
+                name=f"ddl-producer-{producer_idx}-respawn",
+                daemon=True,
+            )
+            p.start()
+            child_end.close()
+            self.processes[i] = p
+            new_ch = PipeChannel(parent_end)
+        self.connection.rejoin_producer(producer_idx, new_ch)
+        logger.info("respawned producer %d", producer_idx)
 
     def abort(self) -> None:
         """Wake producers that may still be blocked in the handshake."""

@@ -33,10 +33,12 @@ class CallbackProtocol(Protocol):
     def on_push_end(self, **kwargs: Any) -> Any: ...
 
 
-#: Hook names considered valid dispatch positions.
+#: Hook names considered valid dispatch positions.  ``fast_forward`` is
+#: dispatched once on elastic rejoin, before the hot loop resumes.
 CALLBACK_POSITIONS: tuple[str, ...] = (
     "on_init",
     "post_init",
+    "fast_forward",
     "on_push_begin",
     "global_shuffle",
     "execute_function",

@@ -58,6 +58,7 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every_epochs: int = 1,
         watchdog: bool = True,
+        watchdog_respawn: bool = False,
         stall_budget_s: float = 300.0,
         metrics: Optional[Metrics] = None,
     ):
@@ -70,6 +71,7 @@ class Trainer:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_epochs = max(1, checkpoint_every_epochs)
         self.watchdog_enabled = watchdog
+        self.watchdog_respawn = watchdog_respawn
         self.stall_budget_s = stall_budget_s
         self.metrics = metrics or default_metrics()
         self._init_params = init_params
@@ -260,7 +262,7 @@ class Trainer:
         shuffler_factory: Any = None,
         loader_kwargs: Optional[dict] = None,
         prefetch_depth: int = 2,
-        window_stream: bool = False,
+        window_stream: Optional[bool] = None,
         config: Any = None,
     ) -> FitResult:
         """Run the full producer/consumer training job; returns FitResult.
@@ -307,6 +309,8 @@ class Trainer:
                 global_shuffle_fraction_exchange = (
                     config.global_shuffle_fraction_exchange
                 )
+            if window_stream is None:
+                window_stream = getattr(config, "window_stream", False)
             loader_kwargs = dict(loader_kwargs or {})
             loader_kwargs.setdefault(
                 "exchange_method", config.exchange_method
@@ -319,6 +323,7 @@ class Trainer:
             )
         nslots = 2 if nslots is None else nslots
         output = "jax" if output is None else output
+        window_stream = bool(window_stream)
         if window_stream and output != "jax":
             raise ValueError("window_stream requires output='jax'")
         global_shuffle_fraction_exchange = (
@@ -387,8 +392,13 @@ class Trainer:
                 ck.apply(loader)
             wd = None
             if trainer.watchdog_enabled and env.workers is not None:
+                # respawn=True turns failure detection into elastic
+                # recovery: dead producer workers are replaced in place
+                # and the run continues (tests/test_elastic.py).
                 wd = Watchdog(
-                    env.workers, stall_budget_s=trainer.stall_budget_s
+                    env.workers,
+                    stall_budget_s=trainer.stall_budget_s,
+                    respawn=trainer.watchdog_respawn,
                 ).start()
             epoch_losses: List[float] = []
             if window_stream:

@@ -97,6 +97,26 @@ class PipeChannel(ControlChannel):
         self._conn.close()
 
 
+def _send_meta(
+    ch: ControlChannel, meta: MetaData_Consumer_To_Producer
+) -> None:
+    """Send the consumer handshake metadata over one channel.
+
+    Each producer gets a DEEP COPY of the metadata (and with it the
+    user's producer function) so THREAD mode has the same code-shipping
+    semantics as PROCESS mode's pickling (reference pickled over ssend,
+    connection.py:73): a shared instance would race on user state (shard
+    cursors, RNGs) across producer threads.  deepcopy rather than a
+    pickle round-trip keeps thread mode usable with locally-defined
+    producer classes.  Only this broadcast is copied — ring handles and
+    tokens on other paths must stay shared — and only for thread
+    channels: PipeChannel already copies by pickling, so copying there
+    would double the peak memory of a producer function that closes over
+    a large dataset.
+    """
+    ch.send(copy.deepcopy(meta) if isinstance(ch, ThreadChannel) else meta)
+
+
 def _resolve_ring(reply: MetaData_Producer_To_Consumer) -> WindowRing:
     """Resolve a handshake reply's ring_ref to a usable ring."""
     ref = getattr(reply, "ring_ref", None)
@@ -124,27 +144,16 @@ class ConsumerConnection:
         self.channels = list(channels)
         self.rings: List[WindowRing] = []
         self.replies: List[MetaData_Producer_To_Consumer] = []
+        self._sent_meta: Optional[MetaData_Consumer_To_Producer] = None
 
     @property
     def n_producers(self) -> int:
         return len(self.channels)
 
     def send_metadata(self, meta: MetaData_Consumer_To_Producer) -> None:
-        # Each producer gets a DEEP COPY of the metadata (and with it the
-        # user's producer function) so THREAD mode has the same
-        # code-shipping semantics as PROCESS mode's pickling (reference
-        # pickled over ssend, connection.py:73): a shared instance would
-        # race on user state (shard cursors, RNGs) across producer threads.
-        # deepcopy rather than a pickle round-trip keeps thread mode usable
-        # with locally-defined producer classes.  Only this broadcast is
-        # copied — ring handles and tokens on other paths must stay shared —
-        # and only for thread channels: PipeChannel already copies by
-        # pickling, so copying there would double the peak memory of a
-        # producer function that closes over a large dataset.
+        self._sent_meta = meta  # kept for elastic rejoin handshakes
         for ch in self.channels:
-            ch.send(
-                copy.deepcopy(meta) if isinstance(ch, ThreadChannel) else meta
-            )
+            _send_meta(ch, meta)
 
     def recv_metadata_as_consumer(self) -> List[MetaData_Producer_To_Consumer]:
         replies = [ch.recv() for ch in self.channels]
@@ -169,6 +178,49 @@ class ConsumerConnection:
         self.rings = [_resolve_ring(r) for r in self.replies]
         return self.rings
 
+    def rejoin_producer(
+        self, producer_idx: int, channel: ControlChannel
+    ) -> MetaData_Producer_To_Consumer:
+        """Re-run the handshake with a RESPAWNED producer (elastic
+        recovery).  The replacement re-derives its geometry from the same
+        metadata, attaches to the surviving ring, and must report the
+        geometry its predecessor reported — the consumer's window
+        bookkeeping cannot change mid-run.
+        """
+        i = producer_idx - 1
+        if self._sent_meta is None:
+            raise TransportError("rejoin before the initial handshake")
+        old = self.replies[i]
+        _send_meta(channel, self._sent_meta)
+        reply = channel.recv()
+        if isinstance(reply, Exception):
+            raise TransportError(
+                f"producer {producer_idx} failed during rejoin"
+            ) from reply
+        if not isinstance(reply, MetaData_Producer_To_Consumer):
+            raise TransportError(f"bad rejoin reply: {reply!r}")
+        if (
+            reply.batches_per_window != old.batches_per_window
+            or tuple(reply.shape) != tuple(old.shape)
+            or tuple(reply.splits) != tuple(old.splits)
+            or reply.dtype != old.dtype
+        ):
+            raise TransportError(
+                f"respawned producer {producer_idx} reported different "
+                f"geometry than its predecessor"
+            )
+        # Swap only once the replacement validated; the dead producer's
+        # channel fd is released rather than leaked.
+        try:
+            self.channels[i].close()
+        except Exception:  # pragma: no cover - already-broken pipe
+            pass
+        self.channels[i] = channel
+        self.replies[i] = reply
+        # self.rings[i] stays as-is: the consumer's attachment to the
+        # surviving ring is untouched by the producer's death.
+        return reply
+
     def shutdown_operation(self) -> None:
         """Wake every producer with the shutdown flag.
 
@@ -192,6 +244,14 @@ class ConsumerConnection:
     def finalize(self) -> None:
         for ring in self.rings:
             ring.close()
+            # Backstop cleanup: a producer that CRASHED leaves its shm
+            # name linked for elastic rejoin; if the run ends without a
+            # respawn, remove it here (idempotent — clean producers
+            # already unlinked their own).
+            try:
+                ring.unlink()
+            except Exception:  # pragma: no cover - best-effort
+                pass
         for ch in self.channels:
             ch.close()
 
@@ -213,6 +273,20 @@ class ProducerConnection:
             raise TransportError(f"bad handshake metadata: {meta!r}")
         return meta
 
+    def attach_ring(self, ring_ref: Any) -> WindowRing:
+        """Adopt a SURVIVING ring (elastic rejoin): by shm name cross-
+        process, by object reference in-process.  The ring's counters are
+        the respawned producer's source of truth for how far its
+        predecessor got."""
+        if isinstance(ring_ref, WindowRing):
+            self.ring = ring_ref
+        else:
+            from ddl_tpu.transport.shm_ring import open_shm_ring
+
+            self.ring = open_shm_ring(ring_ref)
+        self._ring_ref = ring_ref
+        return self.ring
+
     def create_ring(self, nslots: int, slot_bytes: int) -> WindowRing:
         if self.cross_process:
             from ddl_tpu.transport.shm_ring import create_shm_ring, make_ring_name
@@ -231,9 +305,9 @@ class ProducerConnection:
         reply.ring_ref = self._ring_ref  # type: ignore[attr-defined]
         self.channel.send(reply)
 
-    def finalize(self) -> None:
+    def finalize(self, unlink: bool = True) -> None:
         if self.ring is not None:
             self.ring.close()
-            if self.cross_process:
+            if self.cross_process and unlink:
                 self.ring.unlink()
         self.channel.close()

@@ -32,16 +32,32 @@ class Watchdog:
         poll_interval_s: float = 2.0,
         stall_budget_s: float = 120.0,
         on_failure: Optional[Callable[[str], None]] = None,
+        respawn: bool = False,
+        max_respawns: int = 3,
     ):
+        """``respawn=True`` turns detection into recovery: a dead
+        producer worker is replaced in place (``WorkerSet.respawn`` —
+        rejoin the surviving ring, fast-forward to the recorded data
+        position) up to ``max_respawns`` times before falling back to
+        ``on_failure``.  The reference had neither detection nor
+        recovery (SURVEY §5.3)."""
         self.workers = workers
         self.poll_interval_s = poll_interval_s
         self.stall_budget_s = stall_budget_s
         self.on_failure = on_failure or self._default_on_failure
+        self.respawn = respawn
+        self.max_respawns = max_respawns
+        self.respawns: List[int] = []  # producer_idx per respawn event
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_progress: Dict[int, tuple] = {}
         self._last_change: Dict[int, float] = {}
         self.failures: List[str] = []
+        self._dead_idx: Optional[int] = None  # set by check_once
+        # Rings whose producer was just respawned: the replacement is
+        # fast-forward replaying (commits nothing yet), so its stall
+        # budget is widened until its first commit lands.
+        self._replaying: set = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -85,11 +101,14 @@ class Watchdog:
             getattr(r, "is_shutdown", lambda: False)() for r in rings
         ):
             return None
+        self._dead_idx = None
         for i, t in enumerate(self.workers.threads):
             if not t.is_alive():
+                self._dead_idx = i + 1
                 return f"producer thread {i + 1} died"
         for i, p in enumerate(self.workers.processes):
             if p.exitcode is not None and p.exitcode != 0:
+                self._dead_idx = i + 1
                 return f"producer process {i + 1} exited with {p.exitcode}"
         now = time.monotonic()
         for i, ring in enumerate(rings):
@@ -98,12 +117,26 @@ class Watchdog:
             if self._last_progress.get(i) != progress:
                 self._last_progress[i] = progress
                 self._last_change[i] = now
-            elif (
-                st["committed"] == st["released"]  # producer owes a window
-                and now - self._last_change.get(i, now) > self.stall_budget_s
+                self._replaying.discard(i)  # first commit ends the replay
+            # A freshly respawned producer replays its predecessor's
+            # windows before committing anything — give it a much wider
+            # budget so a long replay is not mistaken for a stall.
+            budget = self.stall_budget_s * (
+                10.0 if i in self._replaying else 1.0
+            )
+            if (
+                self._last_progress.get(i) == progress
+                and st["committed"] == st["released"]  # producer owes one
+                and now - self._last_change.get(i, now) > budget
             ):
+                # A hung-but-alive PROCESS worker is replaceable too:
+                # respawn() terminates it before starting the
+                # replacement.  THREAD mode cannot kill a live thread —
+                # WorkerSet.respawn refuses it and the failure falls
+                # through to on_failure.
+                self._dead_idx = i + 1
                 return (
-                    f"ring {i} made no progress for {self.stall_budget_s}s "
+                    f"ring {i} made no progress for {budget}s "
                     f"(committed={st['committed']:.0f})"
                 )
         return None
@@ -120,6 +153,31 @@ class Watchdog:
                 logger.exception("watchdog: check_once raised; continuing")
                 continue
             if reason is not None:
+                if (
+                    self.respawn
+                    and self._dead_idx is not None
+                    and len(self.respawns) < self.max_respawns
+                ):
+                    idx = self._dead_idx
+                    logger.warning(
+                        "watchdog: %s — respawning producer %d "
+                        "(%d/%d respawns used)",
+                        reason, idx, len(self.respawns) + 1,
+                        self.max_respawns,
+                    )
+                    try:
+                        self.workers.respawn(idx)
+                        self.respawns.append(idx)
+                        # Fresh progress baseline for the replaced ring;
+                        # widened budget while it fast-forward replays.
+                        self._last_progress.pop(idx - 1, None)
+                        self._last_change.pop(idx - 1, None)
+                        self._replaying.add(idx - 1)
+                        continue
+                    except Exception:
+                        logger.exception(
+                            "watchdog: respawn of producer %d failed", idx
+                        )
                 self.failures.append(reason)
                 self.on_failure(reason)
                 return

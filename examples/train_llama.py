@@ -90,6 +90,10 @@ def main(mode: str = "thread") -> int:
         mode=mode,
         nslots=2,
         output="jax",
+        # The recommended TPU path: one zero-copy transfer per window, one
+        # jitted scan of optimizer steps per window (numerically identical
+        # to per-batch fit — tests/test_trainer.py proves equivalence).
+        window_stream=True,
     )
     model = llama.LlamaConfig(
         vocab=VOCAB, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -107,10 +111,6 @@ def main(mode: str = "thread") -> int:
     result = trainer.fit(
         TokenStreamProducer(token_file, SEQ_LEN, WINDOW_ROWS),
         config=cfg,
-        # The recommended TPU path: one zero-copy transfer per window, one
-        # jitted scan of optimizer steps per window (numerically identical
-        # to per-batch fit — tests/test_trainer.py proves equivalence).
-        window_stream=True,
     )
     print("epoch losses:", [round(l, 4) for l in result.losses])
     ok = (

@@ -1,0 +1,194 @@
+"""Elastic producer recovery: watchdog-driven respawn with exact data
+continuity.
+
+The reference had no failure recovery — a lost rank deadlocked the job
+until an external timeout (SURVEY §5.3).  Here a dead producer worker is
+replaced in place: the replacement re-handshakes, attaches to the
+surviving ring, fast-forwards its producer function to the data position
+the ring's committed count records, and the consumer's drain sees the
+uninterrupted window sequence.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddl_tpu import (
+    DataProducerOnInitReturn,
+    DistributedDataLoader,
+    Marker,
+    ProducerFunctionSkeleton,
+    distributed_dataloader,
+)
+from ddl_tpu.watchdog import Watchdog
+
+
+class CrashOnceProducer(ProducerFunctionSkeleton):
+    """Serves windows tagged 1,2,3,... and dies ONCE at ``crash_at``.
+
+    The crash fires only if the sentinel file does not exist yet (created
+    just before dying), so the respawned incarnation replays cleanly.
+    Module-level and file-based so the exact same class drives THREAD and
+    spawned PROCESS workers.
+    """
+
+    def __init__(self, sentinel: str, crash_at: int = 4):
+        self.sentinel = sentinel
+        self.crash_at = crash_at
+        self.it = 0
+
+    def on_init(self, producer_idx=0, **kw):
+        return DataProducerOnInitReturn(
+            nData=16, nValues=4, shape=(16, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        self.it += 1
+        if self.it == self.crash_at and not os.path.exists(self.sentinel):
+            with open(self.sentinel, "w") as f:
+                f.write("crashed")
+            raise RuntimeError(f"injected crash at window {self.it}")
+        my_ary[:] = float(self.it)
+
+
+def _drain_with_respawn(mode, sentinel, n_epochs=6):
+    @distributed_dataloader(n_producers=1, mode=mode)
+    def main(env):
+        wd = Watchdog(
+            env.workers, poll_interval_s=0.2, stall_budget_s=60.0,
+            respawn=True,
+        ).start()
+        try:
+            loader = DistributedDataLoader(
+                CrashOnceProducer(sentinel), batch_size=16,
+                connection=env.connection, n_epochs=n_epochs,
+                output="numpy", timeout_s=120.0,
+            )
+            tags = []
+            for _ in range(n_epochs):
+                for x, y in loader:
+                    tags.append(float(x[0, 0]))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+        finally:
+            wd.stop()
+        return tags, list(wd.respawns), list(wd.failures)
+
+    return main()
+
+
+@pytest.mark.parametrize("mode", ["thread", "process"])
+def test_crash_respawn_data_continuity(mode, tmp_path):
+    """A producer dies mid-run; the respawned worker continues the exact
+    window sequence — the consumer sees 1..n_epochs with no gap, no
+    repeat, and no failure escalation."""
+    sentinel = str(tmp_path / f"crash-{mode}")
+    tags, respawns, failures = _drain_with_respawn(mode, sentinel)
+    assert tags == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0], tags
+    assert respawns == [1], respawns
+    assert failures == [], failures
+    assert os.path.exists(sentinel)  # the crash really fired
+
+
+def test_respawn_budget_exhaustion_falls_back(tmp_path):
+    """A producer that keeps dying exhausts max_respawns and the watchdog
+    escalates to on_failure instead of looping forever."""
+
+    class AlwaysCrash(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            return DataProducerOnInitReturn(
+                nData=16, nValues=4, shape=(16, 4), splits=(3, 1)
+            )
+
+        def execute_function(self, my_ary, **kw):
+            raise RuntimeError("injected crash (every incarnation)")
+
+    failures = []
+
+    @distributed_dataloader(n_producers=1, mode="thread")
+    def main(env):
+        wd = Watchdog(
+            env.workers, poll_interval_s=0.1, respawn=True, max_respawns=2,
+            on_failure=lambda r: failures.append(r),
+        ).start()
+        try:
+            with pytest.raises(Exception):
+                loader = DistributedDataLoader(
+                    AlwaysCrash(), batch_size=16,
+                    connection=env.connection, n_epochs=2,
+                    output="numpy", timeout_s=8.0,
+                )
+                for _ in range(2):
+                    for _b in loader:
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+            deadline = time.monotonic() + 10
+            while not failures and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        return len(wd.respawns)
+
+    n_respawns = main()
+    assert n_respawns <= 2
+    assert failures, "watchdog never escalated after budget exhaustion"
+
+
+def test_respawn_rejects_live_thread():
+    """Respawning a healthy thread producer is refused (a second producer
+    on one SPSC ring would corrupt it)."""
+    from ddl_tpu.exceptions import TransportError
+
+    class Slow(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            return DataProducerOnInitReturn(
+                nData=16, nValues=4, shape=(16, 4), splits=(3, 1)
+            )
+
+    @distributed_dataloader(n_producers=1, mode="thread")
+    def main(env):
+        loader = DistributedDataLoader(
+            Slow(), batch_size=16, connection=env.connection, n_epochs=1,
+            output="numpy",
+        )
+        with pytest.raises(TransportError, match="still alive"):
+            env.workers.respawn(1)
+        for _ in loader:
+            loader.mark(Marker.END_OF_BATCH)
+        loader.mark(Marker.END_OF_EPOCH)
+
+    main()
+
+
+def test_fast_forward_default_replays_execute_function():
+    """The skeleton's default fast_forward is n execute_function calls —
+    exact for producers whose state advances only through that hook."""
+
+    class Counting(ProducerFunctionSkeleton):
+        def on_init(self, **kw):
+            return DataProducerOnInitReturn(
+                nData=4, nValues=2, shape=(4, 2), splits=(1, 1)
+            )
+
+        def __init__(self):
+            self.it = 0
+
+        def execute_function(self, my_ary=None, **kw):
+            self.it += 1
+            if my_ary is not None:
+                my_ary[:] = self.it
+
+    a, b = Counting(), Counting()
+    buf = np.zeros((4, 2), np.float32)
+    for _ in range(5):
+        a.execute_function(my_ary=buf)
+    b.fast_forward(5, my_ary=np.zeros((4, 2), np.float32))
+    b.execute_function(my_ary=buf)
+    assert b.it == 6 and a.it == 5
+    assert float(buf[0, 0]) == 6.0
