@@ -125,18 +125,6 @@ class DataPusher:
         # topology and config ask for it (reference datapusher.py:89-108) —
         # and unlike the reference, it will actually run (Q1 fixed).
         self.shuffler = None
-        if rejoin_ring is not None and (
-            topology.n_instances > 1
-            and meta.global_shuffle_fraction_exchange > 0.0
-            and shuffler_factory is not None
-        ):
-            # The exchange schedule of the OTHER instances' pushers has
-            # advanced past the replay; a respawned pusher cannot rejoin
-            # it consistently.
-            raise DoesNotMatchError(
-                producer_idx,
-                "elastic respawn is not supported with global shuffle",
-            )
         if (
             topology.n_instances > 1
             and meta.global_shuffle_fraction_exchange > 0.0
@@ -146,6 +134,15 @@ class DataPusher:
                 init_ret.nData * meta.global_shuffle_fraction_exchange
             )
             if num_exchange > 0:
+                if rejoin_ring is not None:
+                    # The exchange schedule of the OTHER instances'
+                    # pushers has advanced past the replay; a respawned
+                    # pusher cannot rejoin it consistently.
+                    raise DoesNotMatchError(
+                        producer_idx,
+                        "elastic respawn is not supported with global "
+                        "shuffle",
+                    )
                 if self.inplace_fill:
                     # The exchange would operate on nslots-stale slot
                     # content and its result would then be destroyed by

@@ -167,40 +167,56 @@ class WorkerSet:
         channels = []
         if topology.mode is RunMode.THREAD:
             for idx in range(topology.n_producers):
-                consumer_end, producer_end = ThreadChannel.pair()
-                channels.append(consumer_end)
-                conn = ProducerConnection(
-                    producer_end, idx + 1, cross_process=False
-                )
-                t = threading.Thread(
-                    target=_producer_main,
-                    args=(conn, topology, idx + 1, nslots, shuffler_factory),
-                    name=f"ddl-producer-{idx + 1}",
-                    daemon=True,
-                )
-                t.start()
+                ch, t = self._spawn_thread(idx + 1)
+                channels.append(ch)
                 self.threads.append(t)
         else:
-            import multiprocessing as mp
-
-            ctx = mp.get_context("spawn")
             for idx in range(topology.n_producers):
-                parent_end, child_end = mp.Pipe(duplex=True)
-                channels.append(PipeChannel(parent_end))
-                # shuffler_factory must be picklable: it crosses the spawn
-                # boundary exactly like the user's producer function.
-                p = ctx.Process(
-                    target=_process_entry,
-                    args=(child_end, topology, idx + 1, nslots, shuffler_factory),
-                    name=f"ddl-producer-{idx + 1}",
-                    daemon=True,
-                )
-                p.start()
-                # Close the parent's copy of the child end so a dead
-                # producer surfaces as EOF on the channel, not a timeout.
-                child_end.close()
+                ch, p = self._spawn_process(idx + 1)
+                channels.append(ch)
                 self.processes.append(p)
         self.connection = ConsumerConnection(channels)
+
+    # The ONE worker-construction recipe, shared by __init__ and respawn
+    # so the rarely-exercised recovery path cannot drift from the normal
+    # spawn path.
+
+    def _spawn_thread(self, producer_idx: int, rejoin_ring: Any = None):
+        consumer_end, producer_end = ThreadChannel.pair()
+        conn = ProducerConnection(
+            producer_end, producer_idx, cross_process=False
+        )
+        t = threading.Thread(
+            target=_producer_main,
+            args=(conn, self.topology, producer_idx, self.nslots,
+                  self.shuffler_factory, rejoin_ring),
+            name=f"ddl-producer-{producer_idx}"
+            + ("-respawn" if rejoin_ring is not None else ""),
+            daemon=True,
+        )
+        t.start()
+        return consumer_end, t
+
+    def _spawn_process(self, producer_idx: int, rejoin_ring: Any = None):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        parent_end, child_end = mp.Pipe(duplex=True)
+        # shuffler_factory must be picklable: it crosses the spawn
+        # boundary exactly like the user's producer function.
+        p = ctx.Process(
+            target=_process_entry,
+            args=(child_end, self.topology, producer_idx, self.nslots,
+                  self.shuffler_factory, rejoin_ring),
+            name=f"ddl-producer-{producer_idx}"
+            + ("-respawn" if rejoin_ring is not None else ""),
+            daemon=True,
+        )
+        p.start()
+        # Close the parent's copy of the child end so a dead producer
+        # surfaces as EOF on the channel, not a timeout.
+        child_end.close()
+        return PipeChannel(parent_end), p
 
     def respawn(self, producer_idx: int) -> None:
         """Replace a dead producer with a fresh worker that rejoins the
@@ -229,24 +245,9 @@ class WorkerSet:
                     f"producer thread {producer_idx} is still alive; "
                     "only dead thread producers can be respawned"
                 )
-            consumer_end, producer_end = ThreadChannel.pair()
-            conn = ProducerConnection(
-                producer_end, producer_idx, cross_process=False
-            )
-            t = threading.Thread(
-                target=_producer_main,
-                args=(conn, self.topology, producer_idx, self.nslots,
-                      self.shuffler_factory, ring_ref),
-                name=f"ddl-producer-{producer_idx}-respawn",
-                daemon=True,
-            )
-            t.start()
+            new_ch, t = self._spawn_thread(producer_idx, rejoin_ring=ring_ref)
             self.threads[i] = t
-            new_ch: Any = consumer_end
         else:
-            import multiprocessing as mp
-
-            ctx = mp.get_context("spawn")
             old = self.processes[i]
             if old.is_alive():  # stalled rather than dead: replace it
                 old.terminate()
@@ -262,18 +263,8 @@ class WorkerSet:
                         f"producer process {producer_idx} survived "
                         "SIGKILL; cannot safely attach a replacement"
                     )
-            parent_end, child_end = mp.Pipe(duplex=True)
-            p = ctx.Process(
-                target=_process_entry,
-                args=(child_end, self.topology, producer_idx, self.nslots,
-                      self.shuffler_factory, ring_ref),
-                name=f"ddl-producer-{producer_idx}-respawn",
-                daemon=True,
-            )
-            p.start()
-            child_end.close()
+            new_ch, p = self._spawn_process(producer_idx, rejoin_ring=ring_ref)
             self.processes[i] = p
-            new_ch = PipeChannel(parent_end)
         self.connection.rejoin_producer(producer_idx, new_ch)
         logger.info("respawned producer %d", producer_idx)
 

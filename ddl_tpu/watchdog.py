@@ -54,10 +54,11 @@ class Watchdog:
         self._last_change: Dict[int, float] = {}
         self.failures: List[str] = []
         self._dead_idx: Optional[int] = None  # set by check_once
-        # Rings whose producer was just respawned: the replacement is
-        # fast-forward replaying (commits nothing yet), so its stall
-        # budget is widened until its first commit lands.
-        self._replaying: set = set()
+        # ring index -> committed count at respawn time.  While present,
+        # the replacement is fast-forward replaying (commits nothing),
+        # so its stall budget is widened; the entry clears when the
+        # committed count moves PAST the recorded value.
+        self._replaying: Dict[int, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -114,10 +115,14 @@ class Watchdog:
         for i, ring in enumerate(rings):
             st = ring.stats()
             progress = (st["committed"], st["released"])
+            if (
+                i in self._replaying
+                and st["committed"] > self._replaying[i]
+            ):
+                del self._replaying[i]  # first NEW commit ends the replay
             if self._last_progress.get(i) != progress:
                 self._last_progress[i] = progress
                 self._last_change[i] = now
-                self._replaying.discard(i)  # first commit ends the replay
             # A freshly respawned producer replays its predecessor's
             # windows before committing anything — give it a much wider
             # budget so a long replay is not mistaken for a stall.
@@ -168,11 +173,17 @@ class Watchdog:
                     try:
                         self.workers.respawn(idx)
                         self.respawns.append(idx)
-                        # Fresh progress baseline for the replaced ring;
-                        # widened budget while it fast-forward replays.
-                        self._last_progress.pop(idx - 1, None)
-                        self._last_change.pop(idx - 1, None)
-                        self._replaying.add(idx - 1)
+                        # Stall clock restarts at the respawn; the
+                        # widened replay budget holds until the
+                        # committed count moves past its current value.
+                        self._last_change[idx - 1] = time.monotonic()
+                        try:
+                            committed = self.workers.connection.rings[
+                                idx - 1
+                            ].stats()["committed"]
+                        except Exception:  # pragma: no cover
+                            committed = float("-inf")
+                        self._replaying[idx - 1] = committed
                         continue
                     except Exception:
                         logger.exception(

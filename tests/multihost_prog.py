@@ -154,6 +154,38 @@ def main(process_id: int, coordinator: str) -> None:
         return float(loss)
 
     run()
+
+    @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+    def run_stream(env):
+        # Zero-copy window streaming across hosts: loader.windows() with
+        # a global sharding exercises DeviceIngestor._transfer's
+        # process_count > 1 branch (per-host windows assembled into one
+        # global dp-sharded array, no gather).  Window layout is
+        # (bpw, batch, ...), so the BATCH axis carries the dp sharding.
+        mesh = make_mesh({"dp": N_PROCESSES * DEVICES_PER_PROCESS})
+        repl = NamedSharding(mesh, P())
+        gather = jax.jit(lambda x: x, out_shardings=repl)
+        loader = DistributedDataLoader(
+            TaggedProducer(env.topology.instance_idx),
+            batch_size=BATCH,
+            connection=env.connection,
+            n_epochs=2,
+            output="jax",
+            sharding=NamedSharding(mesh, P(None, "dp")),
+        )
+        tags = set()
+        for win in loader.windows():
+            assert win.shape == (
+                N_DATA // BATCH, N_PROCESSES * BATCH, N_VALUES,
+            ), win.shape
+            tags.update(
+                int(t) for t in np.asarray(gather(win))[..., -1].ravel()
+            )
+            loader.mark(Marker.END_OF_EPOCH)
+        # Both hosts' windows landed in every global array.
+        assert {t // 1000 for t in tags} == {0, 1}, tags
+
+    run_stream()
     print(f"MULTIHOST OK process={process_id}", flush=True)
 
 

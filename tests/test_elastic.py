@@ -166,6 +166,56 @@ def test_respawn_rejects_live_thread():
     main()
 
 
+def test_replay_budget_widens_until_new_commit():
+    """While a respawned producer is fast-forward replaying (committed
+    count unchanged), the stall budget is 10x; the first NEW commit
+    restores the normal budget.  Regression test: an early version
+    discarded the replay status on the first post-respawn sweep."""
+
+    class FakeRing:
+        def __init__(self):
+            self.committed = 5.0
+            self.released = 5.0
+
+        def stats(self):
+            return {
+                "committed": self.committed, "released": self.released,
+                "producer_stall_s": 0.0, "consumer_stall_s": 0.0,
+            }
+
+        def is_shutdown(self):
+            return False
+
+    class FakeConn:
+        def __init__(self, rings):
+            self.rings = rings
+
+    class FakeWorkers:
+        def __init__(self, rings):
+            self.connection = FakeConn(rings)
+            self.threads = []
+            self.processes = []
+
+    ring = FakeRing()
+    wd = Watchdog(FakeWorkers([ring]), stall_budget_s=1.0, respawn=True)
+    # Simulate the post-respawn bookkeeping.
+    wd._replaying[0] = ring.committed
+    wd._last_progress[0] = (ring.committed, ring.released)
+    # Stalled 5s: past the 1x budget, well inside the widened 10x.
+    wd._last_change[0] = time.monotonic() - 5.0
+    assert wd.check_once() is None  # replay grace holds across sweeps
+    assert 0 in wd._replaying
+    # The replacement's first new commit ends the replay status...
+    ring.committed = 6.0
+    assert wd.check_once() is None  # progress observed, baseline reset
+    assert 0 not in wd._replaying
+    # ...after which the normal budget applies again.
+    ring.released = 6.0
+    wd.check_once()
+    wd._last_change[0] = time.monotonic() - 5.0
+    assert wd.check_once() is not None  # 5s > 1x budget -> stall flagged
+
+
 def test_fast_forward_default_replays_execute_function():
     """The skeleton's default fast_forward is n execute_function calls —
     exact for producers whose state advances only through that hook."""
