@@ -420,6 +420,95 @@ def _run_train(platform: str, attn_impl: str):
     }
 
 
+def _run_fit(platform: str, attn_impl: str = "flash"):
+    """End-to-end training throughput THROUGH the framework: producer
+    workers → window rings → zero-copy window stream → one scanned
+    multistep per window (``Trainer.fit(window_stream=True)``).  The
+    delta against ``train_*``'s pipeline-less multistep ceiling IS the
+    input-pipeline overhead.
+
+    Timing: one warm fit compiles the scan (the Trainer caches it per
+    window geometry), then a SHORT and a LONG fit on the same Trainer
+    are both timed wall-to-wall and differenced — the fixed per-fit cost
+    (worker spawn, handshake, first fills) cancels out, leaving the
+    steady-state per-window cost: transfer + scan + loss read-back.
+    """
+    import optax
+
+    from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+    from ddl_tpu.models import llama
+    from ddl_tpu.parallel.mesh import make_mesh
+    from ddl_tpu.trainer import Trainer
+
+    import jax
+
+    cfg, batch, seq, _steps = _train_config(platform)
+    cfg = type(cfg)(**{**cfg.__dict__, "attn_impl": attn_impl})
+    bpw = 8 if platform == "tpu" else 2
+    rows = bpw * batch
+    short_windows, long_windows = 2, 10
+
+    class TokenWindows(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            self._rng = np.random.default_rng(producer_idx)
+            return DataProducerOnInitReturn(
+                nData=rows, nValues=seq, shape=(rows, seq), splits=(seq,),
+                dtype=np.int32,
+            )
+
+        def post_init(self, my_ary, **kw):
+            my_ary[:] = self._rng.integers(0, cfg.vocab, my_ary.shape)
+
+        def execute_function(self, my_ary, **kw):
+            # Representative refill: fresh tokens each window.
+            my_ary[:] = self._rng.integers(0, cfg.vocab, my_ary.shape)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
+    trainer = Trainer(
+        loss_fn=lambda p, b: llama.next_token_loss(p, b[0], cfg, mesh=None),
+        optimizer=optax.adamw(3e-4),
+        mesh=mesh,
+        param_specs=llama.param_specs(cfg),
+        init_params=llama.init_params(cfg, jax.random.key(0)),
+        watchdog=False,
+    )
+
+    def one_fit(n):
+        return trainer.fit(
+            TokenWindows(), batch_size=batch, n_epochs=n, n_producers=2,
+            mode="thread", output="jax", window_stream=True,
+        )
+
+    one_fit(short_windows)  # compile + cache the scan
+
+    def timed(n):
+        t0 = time.perf_counter()
+        res = one_fit(n)
+        dt = time.perf_counter() - t0
+        if not all(np.isfinite(v) for v in res.losses):
+            raise RuntimeError(f"non-finite fit losses {res.losses}")
+        return dt, res
+
+    dt_short, _ = best_of(2, lambda: timed(short_windows), key=lambda r: r[0])
+    dt_long, res = best_of(2, lambda: timed(long_windows), key=lambda r: r[0])
+    dd = dt_long - dt_short
+    if dd <= 0:
+        raise RuntimeError(
+            f"implausible fit timings: {long_windows} windows in "
+            f"{dt_long:.3f}s vs {short_windows} in {dt_short:.3f}s"
+        )
+    window_s = dd / (long_windows - short_windows)
+    tokens_per_window = bpw * batch * seq
+    return {
+        "attn_impl": attn_impl,
+        "tokens_per_sec": round(tokens_per_window / window_s, 1),
+        "windows_timed": long_windows - short_windows,
+        "steps_per_window": bpw,
+        "window_time_ms": round(window_s * 1e3, 2),
+        "final_loss": round(res.losses[-1], 4),
+    }
+
+
 # -- attention seq-length sweep ----------------------------------------------
 
 # One harness shared with tools/probe_attn.py (which imports these), so the
@@ -687,6 +776,20 @@ def main() -> None:
                 train_attn_impl=best["attn_impl"],
                 device_kind=best["device_kind"],
             )
+        try:
+            impl = "flash" if platform == "tpu" else "dense"
+            fit = _run_fit(platform, impl)
+            if impl in train:
+                # End-to-end (pipeline included) vs the multistep ceiling:
+                # the input pipeline's cost on training throughput.
+                fit["pipeline_overhead"] = round(
+                    1.0
+                    - fit["tokens_per_sec"] / train[impl]["tokens_per_sec"],
+                    4,
+                )
+            result["fit_stream"] = fit
+        except Exception as e:  # noqa: BLE001
+            errors["fit_stream"] = f"{type(e).__name__}: {e}"
         if platform == "tpu":
             try:
                 result["attn_sweep"] = _attn_sweep()

@@ -35,6 +35,30 @@ from ddl_tpu.observability import Metrics, metrics as default_metrics
 logger = logging.getLogger("ddl_tpu")
 
 
+def _stream_splits(loader: Any) -> Tuple[int, ...]:
+    """The single column-split tuple a window stream serves, validated:
+    heterogeneous per-producer splits cannot ride one scanned program."""
+    splits = set(loader.splits_per_producer)
+    if len(splits) != 1:
+        raise ValueError(
+            "window_stream requires homogeneous column splits across "
+            f"producers, got {sorted(splits)}"
+        )
+    (col_splits,) = splits
+    return col_splits
+
+
+def _window_cols(win: Any, col_splits: Sequence[int]) -> Tuple[Any, ...]:
+    """Split a (bpw, batch, *features) device window into column arrays
+    along the FIRST feature axis — the axis every batch-path split uses
+    (``dataloader._split_columns`` slices ``batch[:, off:off+w]``)."""
+    cols, off = [], 0
+    for w in col_splits:
+        cols.append(win[:, :, off : off + w])
+        off += w
+    return tuple(cols)
+
+
 @dataclasses.dataclass
 class FitResult:
     state: Any  # final TrainState
@@ -82,6 +106,9 @@ class Trainer:
         self._init_fn, self._step_fn = make_train_step(
             loss_fn, optimizer, mesh, param_specs, batch_spec=batch_spec
         )
+        # window_stream multistep programs, keyed by steps-per-window, so
+        # repeated fit() calls on one Trainer reuse the compiled scan.
+        self._multistep_cache: dict = {}
 
     # -- checkpoint plumbing ----------------------------------------------
 
@@ -136,6 +163,7 @@ class Trainer:
         n_producers: Optional[int] = None,
         mode: Optional[str] = None,
         output: str = "numpy",
+        window_stream: bool = False,
     ) -> float:
         """One-epoch metric pass over a (held-out) producer's windows.
 
@@ -144,9 +172,13 @@ class Trainer:
         batch and returns the mean.  Uses the same producer/consumer
         machinery as ``fit`` but runs no optimizer step — e.g. pass
         ``models.vit.accuracy`` for classification eval.
+        ``window_stream=True`` (``output="jax"``): the window streams
+        zero-copy and all its batches evaluate in one jitted scan.
         """
         from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
 
+        if window_stream and output != "jax":
+            raise ValueError("window_stream requires output='jax'")
         trainer = self
 
         @distributed_dataloader(n_producers=n_producers, mode=mode)
@@ -157,7 +189,12 @@ class Trainer:
                 # distributed over the mesh, not whole on device 0.
                 from ddl_tpu.parallel.train import _named
 
-                lkw["sharding"] = _named(trainer.mesh, trainer._batch_spec)
+                spec = (
+                    P(*((None,) + tuple(trainer._batch_spec)))
+                    if window_stream
+                    else trainer._batch_spec
+                )
+                lkw["sharding"] = _named(trainer.mesh, spec)
             loader = DistributedDataLoader(
                 producer_function,
                 batch_size=batch_size,
@@ -167,6 +204,24 @@ class Trainer:
                 metrics=trainer.metrics,
                 **lkw,
             )
+            if window_stream:
+                import jax
+
+                col_splits = _stream_splits(loader)
+
+                @jax.jit
+                def window_metric(params, win):
+                    vals = jax.vmap(
+                        lambda *b: metric_fn(params, tuple(b))
+                    )(*_window_cols(win, col_splits))
+                    return vals.mean()
+
+                vals = []
+                for win in loader.windows():
+                    vals.append(window_metric(state.params, win))
+                    loader.mark(Marker.END_OF_EPOCH)
+                fvals = [float(v) for v in vals]
+                return sum(fvals) / len(fvals) if fvals else float("nan")
             it = loader.prefetch(2) if output == "jax" else loader
             vals: List[Any] = []
             for batch in it:
@@ -199,29 +254,21 @@ class Trainer:
         from ddl_tpu import Marker
         from ddl_tpu.parallel.train import make_multistep
 
-        splits = set(loader.splits_per_producer)
-        if len(splits) != 1:
-            raise ValueError(
-                "window_stream requires homogeneous column splits across "
-                f"producers, got {sorted(splits)}"
+        col_splits = _stream_splits(loader)
+        multi_fn = self._multistep_cache.get(loader.batches_per_window)
+        if multi_fn is None:
+            _, multi_fn = make_multistep(
+                self._loss_fn, self._optimizer, self.mesh,
+                self._param_specs, batch_spec=self._batch_spec,
+                n_steps=loader.batches_per_window,
             )
-        (col_splits,) = splits
-        _, multi_fn = make_multistep(
-            self._loss_fn, self._optimizer, self.mesh, self._param_specs,
-            batch_spec=self._batch_spec,
-            n_steps=loader.batches_per_window,
-        )
+            self._multistep_cache[loader.batches_per_window] = multi_fn
         pending = None
         epoch = start_epoch
         for win in loader.windows():
-            cols, off = [], 0
-            for w in col_splits:
-                # Axis 2 is the first feature axis of the (bpw, batch,
-                # *features) window — the axis every batch-path split
-                # uses (_split_columns slices batch[:, off:off+w]).
-                cols.append(win[:, :, off : off + w])
-                off += w
-            state, losses = multi_fn(state, tuple(cols), per_step=True)
+            state, losses = multi_fn(
+                state, _window_cols(win, col_splits), per_step=True
+            )
             if pending is not None:
                 epoch_losses.append(float(pending.mean()))
             pending = losses

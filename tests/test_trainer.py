@@ -336,3 +336,11 @@ def test_evaluate_metric_pass(rng, tmp_path):
         batch_size=8, n_producers=2, mode="thread", output="jax",
     )
     assert abs(acc_jax - acc) < 1e-6, (acc_jax, acc)
+    # window-stream eval (one jitted scan per streamed window) agrees.
+    acc_win = trainer.evaluate(
+        producer, res.state,
+        metric_fn=lambda p, b: vit.accuracy(p, b, cfg),
+        batch_size=8, n_producers=2, mode="thread", output="jax",
+        window_stream=True,
+    )
+    assert abs(acc_win - acc) < 1e-6, (acc_win, acc)
