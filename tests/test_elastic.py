@@ -96,6 +96,69 @@ def test_crash_respawn_data_continuity(mode, tmp_path):
     assert os.path.exists(sentinel)  # the crash really fired
 
 
+class HangOnceProducer(ProducerFunctionSkeleton):
+    """Serves windows tagged 1,2,3,... and HANGS (rather than dying) once
+    at ``hang_at`` — first incarnation only, gated by the sentinel file.
+    Exercises the terminate-then-respawn path for stalled-but-alive
+    PROCESS workers."""
+
+    def __init__(self, sentinel: str, hang_at: int = 3):
+        self.sentinel = sentinel
+        self.hang_at = hang_at
+        self.it = 0
+
+    def on_init(self, producer_idx=0, **kw):
+        return DataProducerOnInitReturn(
+            nData=16, nValues=4, shape=(16, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        self.it += 1
+        if self.it == self.hang_at and not os.path.exists(self.sentinel):
+            with open(self.sentinel, "w") as f:
+                f.write("hung")
+            time.sleep(3600)  # simulate a wedged worker
+        my_ary[:] = float(self.it)
+
+
+def test_hung_producer_terminated_and_respawned(tmp_path):
+    """A stalled-but-alive PROCESS worker is terminated and replaced; the
+    window sequence continues without gap or repeat."""
+    sentinel = str(tmp_path / "hang")
+
+    @distributed_dataloader(n_producers=1, mode="process")
+    def main(env):
+        # Budget must comfortably exceed worker-process startup (~5s on a
+        # loaded 1-core host) or a slow spawn reads as a stall and a
+        # spurious respawn breaks the [1] assertion.
+        wd = Watchdog(
+            env.workers, poll_interval_s=0.2, stall_budget_s=12.0,
+            respawn=True,
+        ).start()
+        try:
+            loader = DistributedDataLoader(
+                HangOnceProducer(sentinel), batch_size=16,
+                connection=env.connection, n_epochs=5,
+                output="numpy", timeout_s=180.0,
+            )
+            tags = []
+            for _ in range(5):
+                for x, y in loader:
+                    tags.append(float(x[0, 0]))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+        finally:
+            wd.stop()
+        return tags, list(wd.respawns)
+
+    tags, respawns = main()
+    assert tags == [1.0, 2.0, 3.0, 4.0, 5.0], tags
+    assert respawns == [1], respawns
+
+
 def test_respawn_budget_exhaustion_falls_back(tmp_path):
     """A producer that keeps dying exhausts max_respawns and the watchdog
     escalates to on_failure instead of looping forever."""
