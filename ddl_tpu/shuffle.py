@@ -88,9 +88,12 @@ def exchange_slices(num_exchange: int) -> Tuple[slice, slice]:
     return slice(0, half), slice(half, 2 * half)
 
 
-class _Rendezvous:
+class Rendezvous:
     """In-process exchange fabric: one board per producer-index, shared by
-    all simulated instances.  Thread-safe; used by ThreadExchangeShuffler."""
+    all simulated instances.  Thread-safe; used by ThreadExchangeShuffler.
+    Public: pass a fresh instance per run to
+    ``ThreadExchangeShuffler.factory(rendezvous=...)`` when wiring
+    multiple instances in one process (examples/global_shuffle.py)."""
 
     def __init__(self) -> None:
         self._lock = threading.Condition()
@@ -128,7 +131,7 @@ class _Rendezvous:
             self._boxes.pop(key, None)
 
 
-_default_rendezvous = _Rendezvous()
+_default_rendezvous = Rendezvous()
 
 
 class ThreadExchangeShuffler:
@@ -145,7 +148,7 @@ class ThreadExchangeShuffler:
         producer_idx: int,
         num_exchange: int,
         exchange_method: str = "sendrecv_replace",
-        rendezvous: Optional[_Rendezvous] = None,
+        rendezvous: Optional[Rendezvous] = None,
         seed: int = 0,
     ):
         if exchange_method not in EXCHANGE_METHODS:
@@ -186,7 +189,7 @@ class ThreadExchangeShuffler:
                 # our half so a later run on the same rendezvous cannot
                 # pop this round's stale rows as its own round 0.  (A
                 # producer that CRASHES mid-exchange can still leave a
-                # box behind — pass a fresh _Rendezvous per run where
+                # box behind — pass a fresh Rendezvous per run where
                 # that matters rather than the module default.)
                 self._rdv.discard(put_key)
                 raise
@@ -194,7 +197,7 @@ class ThreadExchangeShuffler:
 
     # Factory signature expected by DataPusher's shuffler_factory hook.
     @classmethod
-    def factory(cls, rendezvous: Optional[_Rendezvous] = None, seed: int = 0):
+    def factory(cls, rendezvous: Optional[Rendezvous] = None, seed: int = 0):
         def make(
             topology: Topology,
             producer_idx: int,

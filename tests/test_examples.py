@@ -53,3 +53,9 @@ def test_train_llama_example(tmp_path):
 def test_train_vit_example(tmp_path):
     out = _run("train_vit.py")
     assert "PASS" in out
+
+
+@pytest.mark.slow
+def test_global_shuffle_example():
+    out = _run("global_shuffle.py")
+    assert "PASS" in out

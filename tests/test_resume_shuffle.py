@@ -23,7 +23,7 @@ from ddl_tpu import (
 )
 from ddl_tpu.checkpoint import LoaderCheckpoint
 from ddl_tpu.datapusher import DataPusher
-from ddl_tpu.shuffle import ThreadExchangeShuffler, _Rendezvous
+from ddl_tpu.shuffle import ThreadExchangeShuffler, Rendezvous
 from ddl_tpu.transport.connection import (
     ConsumerConnection,
     ProducerConnection,
@@ -131,13 +131,13 @@ class TestResumeWithShuffle:
         cross-instance exchange rows, i.e. the shuffle schedule continued
         rather than restarting at round 0."""
         full = _run_two_instances(
-            [(0, 4)], [_Rendezvous()],
+            [(0, 4)], [Rendezvous()],
         )
         ckpts = {
             0: str(tmp_path / "inst0.json"), 1: str(tmp_path / "inst1.json")
         }
         split = _run_two_instances(
-            [(0, 2), (2, 4)], [_Rendezvous(), _Rendezvous()], ckpts=ckpts,
+            [(0, 2), (2, 4)], [Rendezvous(), Rendezvous()], ckpts=ckpts,
         )
         for i in (0, 1):
             assert len(full[i]) == len(split[i]) == 4

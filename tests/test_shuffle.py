@@ -11,7 +11,7 @@ from hypothesis import given, settings, strategies as st
 from ddl_tpu.exceptions import DDLError
 from ddl_tpu.shuffle import (
     ThreadExchangeShuffler,
-    _Rendezvous,
+    Rendezvous,
     exchange_permutation,
     exchange_slices,
     inverse_permutation,
@@ -62,7 +62,7 @@ class TestThreadExchange:
     def _run_instances(self, n_instances, n_rows=8, num_exchange=4, rounds=1):
         """Simulate the same producer-idx across n instances, each with a
         tagged window; run `rounds` exchange rounds concurrently."""
-        rdv = _Rendezvous()
+        rdv = Rendezvous()
         arys = [
             np.full((n_rows, 2), float(i), dtype=np.float32)
             for i in range(n_instances)
@@ -203,7 +203,7 @@ class TestEndToEndGlobalShuffle:
             def post_init(self, my_ary, **kw):
                 my_ary[:] = self.tag
 
-        rdv = _Rendezvous()
+        rdv = Rendezvous()
         results = {}
 
         def run_instance(i):
@@ -256,9 +256,9 @@ class TestRendezvousShutdown:
         rendezvous timeout — the flake this fixes stranded a producer 60s
         at phase teardown."""
         from ddl_tpu.exceptions import ShutdownRequested
-        from ddl_tpu.shuffle import _Rendezvous
+        from ddl_tpu.shuffle import Rendezvous
 
-        rdv = _Rendezvous()
+        rdv = Rendezvous()
         flag = {"down": False}
         t0 = time.monotonic()
 
@@ -277,7 +277,7 @@ class TestRendezvousShutdown:
         no partner; flagging its ring shuts the pipeline down cleanly."""
         from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
         from ddl_tpu.datapusher import DataPusher
-        from ddl_tpu.shuffle import _Rendezvous
+        from ddl_tpu.shuffle import Rendezvous
         from ddl_tpu.transport.connection import (
             ConsumerConnection,
             ProducerConnection,
@@ -302,7 +302,7 @@ class TestRendezvousShutdown:
                         mode=RunMode.THREAD)
         cons_end, prod_end = ThreadChannel.pair()
         pconn = ProducerConnection(prod_end, 1, cross_process=False)
-        rdv = _Rendezvous()  # private: partner instance never shows up
+        rdv = Rendezvous()  # private: partner instance never shows up
 
         def producer():
             DataPusher(
@@ -332,9 +332,9 @@ class TestRendezvousShutdown:
         """A shuffler whose take aborts must discard its own put so a
         later run on the same rendezvous can't pop stale rows."""
         from ddl_tpu.exceptions import ShutdownRequested
-        from ddl_tpu.shuffle import _Rendezvous
+        from ddl_tpu.shuffle import Rendezvous
 
-        rdv = _Rendezvous()
+        rdv = Rendezvous()
         topo = Topology(n_instances=2, instance_idx=0, n_producers=1,
                         mode=RunMode.THREAD)
         sh = ThreadExchangeShuffler(topo, 1, num_exchange=4, rendezvous=rdv)
