@@ -142,6 +142,7 @@ def forward(
     tokens: jax.Array,
     cfg: LlamaConfig,
     mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Next-token logits, (B, T, vocab).
 
@@ -149,6 +150,10 @@ def forward(
     sequence-parallel ring attention (K/V rotating over ICI); otherwise
     dense causal attention.  RoPE positions are global either way (the
     token axis is only *sharded*, never re-indexed).
+
+    ``segment_ids`` (B, T): packed-pretraining batches — attention stays
+    within each packed document (kernel-level masking; RoPE positions
+    remain row-global, the common packed-training convention).
     """
     from ddl_tpu.parallel.ring_attention import attention
 
@@ -168,7 +173,8 @@ def forward(
         # block, so ring attention rotates 1/rep of the bytes over ICI.
         rep = cfg.n_heads // cfg.n_kv_heads
         attn = attention(
-            q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True, kv_repeat=rep
+            q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
+            kv_repeat=rep, segment_ids=segment_ids,
         )
         x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
 
@@ -186,14 +192,22 @@ def next_token_loss(
     tokens: jax.Array,
     cfg: LlamaConfig,
     mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Mean cross-entropy of next-token prediction over (B, T) tokens.
 
     Targets are ``roll(tokens, -1)`` with the final position masked rather
     than a ``[:-1]`` slice — the sequence axis keeps its full length, so it
     stays evenly shardable over ``sp``.
+
+    With ``segment_ids`` (packed batches), attention is segment-masked
+    and the loss additionally drops positions whose next token belongs to
+    a different document (the cross-document boundary predictions).
     """
     from ddl_tpu.models.losses import next_token_cross_entropy
 
-    logits = forward(params, tokens, cfg, mesh)
-    return next_token_cross_entropy(logits, tokens)
+    logits = forward(params, tokens, cfg, mesh, segment_ids=segment_ids)
+    if segment_ids is None:
+        return next_token_cross_entropy(logits, tokens)
+    boundary = segment_ids != jnp.roll(segment_ids, -1, axis=1)
+    return next_token_cross_entropy(logits, tokens, extra_mask=boundary)

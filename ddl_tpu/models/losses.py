@@ -36,15 +36,21 @@ def cross_entropy(
 
 
 def next_token_cross_entropy(
-    logits: jax.Array, tokens: jax.Array
+    logits: jax.Array,
+    tokens: jax.Array,
+    extra_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Mean CE of next-token prediction over (B, T) ``tokens``.
 
     Targets are ``roll(tokens, -1)`` with the final position masked
     rather than a ``[:-1]`` slice — the sequence axis keeps its full
-    length, so it stays evenly shardable over ``sp``.
+    length, so it stays evenly shardable over ``sp``.  ``extra_mask``
+    (B, T) True drops additional positions (e.g. packed-document
+    boundaries, where the "next token" belongs to another document).
     """
     T = tokens.shape[1]
     targets = jnp.roll(tokens, -1, axis=1)
-    mask = (jnp.arange(T) < T - 1)[None, :]
+    mask = jnp.broadcast_to((jnp.arange(T) < T - 1)[None, :], tokens.shape)
+    if extra_mask is not None:
+        mask = mask & jnp.logical_not(extra_mask)
     return cross_entropy(logits, targets, mask)

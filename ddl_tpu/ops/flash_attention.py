@@ -85,9 +85,19 @@ def _crosses_diag(offs_ref, i, j, block_q, block_k, causal):
     )
 
 
+def _seg_invalid(seg):
+    """(bq, bk) True where query and key belong to different packed
+    segments.  ``seg`` is the (seg_q_ref, seg_k_ref) pair of (1,1,b,1)
+    int32 blocks, or None when the batch is unpacked."""
+    sq = seg[0][0, 0][:, 0]  # (bq,)
+    sk = seg[1][0, 0][:, 0]  # (bk,)
+    return sq[:, None] != sk[None, :]
+
+
 def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_ref, l_ref, acc_ref, *, scale: float, causal: bool,
-                block_q: int, block_k: int, kv_len: int, precision):
+                block_q: int, block_k: int, kv_len: int, precision,
+                seg=None):
     i = pl.program_id(2)  # Q block
     j = pl.program_id(3)  # KV block (innermost, sequential)
 
@@ -136,6 +146,9 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         _crosses_diag(offs_ref, i, j, block_q, block_k, causal)
         | ((j + 1) * block_k > kv_len)
     )
+    if seg is not None:
+        # Packed segments can differ anywhere — every live block masks.
+        needs_mask = needs_mask | (j >= 0)
 
     @pl.when(live & needs_mask)
     def _attend_masked():
@@ -144,6 +157,8 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         invalid = k_loc >= kv_len  # padded keys
         if causal:
             invalid |= k_pos > q_pos
+        if seg is not None:
+            invalid |= _seg_invalid(seg)
         _update(jnp.where(invalid, _NEG_INF, s))
 
     @pl.when(live & jnp.logical_not(needs_mask))
@@ -176,7 +191,7 @@ def _block_scores(q_ref, k_ref, scale, precision):
 
 def _bwd_p_dispatch(offs_ref, q_ref, k_ref, lse_ref, i, j, accum, *,
                     scale, causal, block_q, block_k, seq_len, kv_len,
-                    precision):
+                    precision, seg=None):
     """Backward-pass block dispatch shared by the dQ and dK/dV kernels:
     dead blocks skipped, boundary blocks recompute p with full masking,
     interior blocks use the bare ``exp(s - lse)`` fast path (statement-
@@ -186,6 +201,8 @@ def _bwd_p_dispatch(offs_ref, q_ref, k_ref, lse_ref, i, j, accum, *,
     needs_mask = _needs_mask_bwd(
         offs_ref, i, j, block_q, block_k, causal, seq_len, kv_len
     )
+    if seg is not None:
+        needs_mask = needs_mask | (j >= 0)  # packed: every block masks
 
     def scores():
         return _block_scores(q_ref, k_ref, scale, precision)
@@ -195,7 +212,7 @@ def _bwd_p_dispatch(offs_ref, q_ref, k_ref, lse_ref, i, j, accum, *,
         accum(_p_masked(
             offs_ref, scores(), lse_ref[0, 0][:, 0], i, j, causal=causal,
             block_q=block_q, block_k=block_k, seq_len=seq_len,
-            kv_len=kv_len,
+            kv_len=kv_len, seg=seg,
         ))
 
     @pl.when(live & jnp.logical_not(needs_mask))
@@ -204,13 +221,15 @@ def _bwd_p_dispatch(offs_ref, q_ref, k_ref, lse_ref, i, j, accum, *,
 
 
 def _p_masked(offs_ref, s, lse, i, j, *, causal, block_q, block_k,
-              seq_len, kv_len):
+              seq_len, kv_len, seg=None):
     """p = exp(s - lse) with mask/padding/empty-row handling (the slow,
     boundary-block path — interior blocks use the bare exp)."""
     q_pos, k_pos, q_loc, k_loc = _positions(offs_ref, i, j, block_q, block_k)
     invalid = (k_loc >= kv_len) | (q_loc >= seq_len)
     if causal:
         invalid |= k_pos > q_pos
+    if seg is not None:
+        invalid |= _seg_invalid(seg)
     empty = lse <= _NEG_INF / 2  # (bq,)
     p = jnp.exp(s - jnp.where(empty, 0.0, lse)[:, None])
     return jnp.where(invalid | empty[:, None], 0.0, p)
@@ -232,7 +251,7 @@ def _needs_mask_bwd(offs_ref, i, j, block_q, block_k, causal, seq_len,
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dlse_ref, dq_ref, dq_acc, *, scale: float, causal: bool,
                block_q: int, block_k: int, seq_len: int, kv_len: int,
-               precision):
+               precision, seg=None):
     i = pl.program_id(2)  # Q block
     j = pl.program_id(3)  # KV block (innermost, sequential)
 
@@ -257,7 +276,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     _bwd_p_dispatch(
         offs_ref, q_ref, k_ref, lse_ref, i, j, _accum, scale=scale,
         causal=causal, block_q=block_q, block_k=block_k, seq_len=seq_len,
-        kv_len=kv_len, precision=precision,
+        kv_len=kv_len, precision=precision, seg=seg,
     )
 
     @pl.when(j == pl.num_programs(3) - 1)
@@ -268,7 +287,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
                 causal: bool, block_q: int, block_k: int, seq_len: int,
-                kv_len: int, precision):
+                kv_len: int, precision, seg=None):
     j = pl.program_id(2)  # KV block
     i = pl.program_id(3)  # Q block (innermost, sequential)
 
@@ -298,13 +317,39 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     _bwd_p_dispatch(
         offs_ref, q_ref, k_ref, lse_ref, i, j, _accum, scale=scale,
         causal=causal, block_q=block_q, block_k=block_k, seq_len=seq_len,
-        kv_len=kv_len, precision=precision,
+        kv_len=kv_len, precision=precision, seg=seg,
     )
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finish():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# Packed-segment kernel adapters: same bodies, two extra int32 input refs
+# (query-/key-segment blocks) spliced in by position.  Separate entry
+# points keep the unpacked kernels' ref layout byte-identical.
+
+
+def _fwd_kernel_seg(offs_ref, q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref,
+                    lse_ref, m_ref, l_ref, acc_ref, **kw):
+    _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
+                l_ref, acc_ref, seg=(sq_ref, sk_ref), **kw)
+
+
+def _dq_kernel_seg(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dlse_ref, sq_ref, sk_ref, dq_ref, dq_acc,
+                   **kw):
+    _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dlse_ref, dq_ref, dq_acc, seg=(sq_ref, sk_ref), **kw)
+
+
+def _dkv_kernel_seg(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dlse_ref, sq_ref, sk_ref, dk_ref, dv_ref,
+                    dk_acc, dv_acc, **kw):
+    _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                seg=(sq_ref, sk_ref), **kw)
 
 
 def _prep(q, k, v, block_q, block_k):
@@ -345,8 +390,30 @@ def _offsets_arr(q_offset, k_offset):
     )
 
 
+def _prep_seg(seg, T_padded):
+    """(B, T) segment ids → (B, 1, T_padded, 1) int32 for block mapping.
+    Pad rows get -1; padded keys are independently masked by ``kv_len``
+    and padded query rows are sliced off the output."""
+    B, T = seg.shape
+    s = jnp.asarray(seg, jnp.int32)
+    if T_padded != T:
+        s = jnp.pad(s, ((0, 0), (0, T_padded - T)), constant_values=-1)
+    return s[:, None, :, None]
+
+
+def _seg_specs(block_q, block_k):
+    """Block specs for the (B, 1, T, 1) segment-id arrays (no head axis)."""
+    sq = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, i, j, *_refs: (b, 0, i, 0)
+    )
+    sk = pl.BlockSpec(
+        (1, 1, block_k, 1), lambda b, h, i, j, *_refs: (b, 0, j, 0)
+    )
+    return sq, sk
+
+
 def _fwd_impl(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
-              interpret):
+              interpret, seg_q=None, seg_k=None):
     assert q.shape[2] == k.shape[2] * kv_repeat, (q.shape, k.shape, kv_repeat)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -355,9 +422,13 @@ def _fwd_impl(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
     qt, kt, vt, block_q, block_k = _prep(q, k, v, block_q, block_k)
     Tq, Tk = qt.shape[2], kt.shape[2]
     precision = _precision_for(q.dtype)
-    kernel = functools.partial(
-        _fwd_kernel, scale=1.0 / (D**0.5), causal=causal, block_q=block_q,
+    packed = seg_q is not None
+    common = dict(
+        scale=1.0 / (D**0.5), causal=causal, block_q=block_q,
         block_k=block_k, kv_len=Tkv, precision=precision,
+    )
+    kernel = functools.partial(
+        _fwd_kernel_seg if packed else _fwd_kernel, **common
     )
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, D),
@@ -369,10 +440,16 @@ def _fwd_impl(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
     row_spec = pl.BlockSpec(
         (1, 1, block_q, 1), lambda b, h, i, j, *_refs: (b, h, i, 0)
     )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [qt, kt, vt]
+    if packed:
+        sq_spec, sk_spec = _seg_specs(block_q, block_k)
+        in_specs += [sq_spec, sk_spec]
+        inputs += [_prep_seg(seg_q, Tq), _prep_seg(seg_k, Tk)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, H, Tq // block_q, Tk // block_k),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=[q_spec, row_spec],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
@@ -388,7 +465,7 @@ def _fwd_impl(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
             jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(offsets, qt, kt, vt)
+    )(offsets, *inputs)
     o = out[:, :, :T] if Tq != T else out
     return (
         jnp.moveaxis(o, 1, 2),
@@ -401,12 +478,14 @@ def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, cts):
     do, dlse = cts
     # Resolved block sizes / interpret flag ride in the residuals so both
     # passes use identical values (the nondiff args are pre-resolution).
-    q, k, v, offsets, out_padded, lse, interpret, block_q, block_k = res
+    (q, k, v, offsets, out_padded, lse, interpret, block_q, block_k,
+     seg_q, seg_k) = res
     B, T, H, D = q.shape
     Tkv, Hkv = k.shape[1], k.shape[2]
     qt, kt, vt, block_q, block_k = _prep(q, k, v, block_q, block_k)
     Tq, Tk = qt.shape[2], kt.shape[2]
     precision = _precision_for(q.dtype)
+    packed = seg_q is not None
 
     dot = jnp.moveaxis(do, 2, 1)
     if Tq != T:
@@ -436,19 +515,26 @@ def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, cts):
     row_spec = pl.BlockSpec(
         (1, 1, block_q, 1), lambda b, h, i, j, *_refs: (b, h, i, 0)
     )
+    dq_in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                   row_spec]
+    dq_inputs = [qt, kt, vt, dot, lse, delta, dl]
+    if packed:
+        sq_spec, sk_spec = _seg_specs(block_q, block_k)
+        dq_in_specs += [sq_spec, sk_spec]
+        dq_inputs += [_prep_seg(seg_q, Tq), _prep_seg(seg_k, Tk)]
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **common),
+        functools.partial(_dq_kernel_seg if packed else _dq_kernel,
+                          **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H, Tq // block_q, Tk // block_k),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
-                      row_spec],
+            in_specs=dq_in_specs,
             out_specs=q_spec,
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
         interpret=interpret,
-    )(offsets, qt, kt, vt, dot, lse, delta, dl)
+    )(offsets, *dq_inputs)
 
     # dK/dV: grid transposed so the Q axis is innermost (sequential).
     q_spec_t = pl.BlockSpec(
@@ -464,13 +550,26 @@ def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, cts):
     out_kv_t = pl.BlockSpec(
         (1, 1, block_k, D), lambda b, h, j, i, *_refs: (b, h, j, 0)
     )
+    dkv_in_specs = [q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                    row_spec_t, row_spec_t]
+    dkv_inputs = [qt, kt, vt, dot, lse, delta, dl]
+    if packed:
+        # Transposed grid: axis 2 is the KV block, axis 3 the Q block.
+        sq_spec_t = pl.BlockSpec(
+            (1, 1, block_q, 1), lambda b, h, j, i, *_refs: (b, 0, i, 0)
+        )
+        sk_spec_t = pl.BlockSpec(
+            (1, 1, block_k, 1), lambda b, h, j, i, *_refs: (b, 0, j, 0)
+        )
+        dkv_in_specs += [sq_spec_t, sk_spec_t]
+        dkv_inputs += [_prep_seg(seg_q, Tq), _prep_seg(seg_k, Tk)]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **common),
+        functools.partial(_dkv_kernel_seg if packed else _dkv_kernel,
+                          **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H, Tk // block_k, Tq // block_q),
-            in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
-                      row_spec_t, row_spec_t],
+            in_specs=dkv_in_specs,
             out_specs=[out_kv_t, out_kv_t],
             scratch_shapes=[
                 pltpu.VMEM((block_k, D), jnp.float32),
@@ -482,7 +581,7 @@ def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, cts):
             jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
         ],
         interpret=interpret,
-    )(offsets, qt, kt, vt, dot, lse, delta, dl)
+    )(offsets, *dkv_inputs)
 
     if Tq != T:
         dq = dq[:, :, :T]
@@ -515,11 +614,50 @@ def _vjp_fwd(q, k, v, offsets, causal, kv_repeat, block_q, block_k,
         q, k, v, offsets, causal, kv_repeat, block_q, block_k, interpret
     )
     return (out, lse), (
-        q, k, v, offsets, out_padded, lse_padded, ipret, bq, bk
+        q, k, v, offsets, out_padded, lse_padded, ipret, bq, bk, None, None
     )
 
 
 _flash_core.defvjp(_vjp_fwd, _bwd_impl)
+
+
+# Packed-segment core: identical math plus the segment mask.  A separate
+# custom_vjp keeps the unpacked core's signature (and its validated
+# behavior) untouched; segment ids are integer inputs with float0
+# cotangents, like ``offsets``.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_core_seg(q, k, v, offsets, seg_q, seg_k, causal, kv_repeat,
+                    block_q, block_k, interpret):
+    out, lse, _ = _fwd_impl(
+        q, k, v, offsets, causal, kv_repeat, block_q, block_k, interpret,
+        seg_q=seg_q, seg_k=seg_k,
+    )
+    return out, lse
+
+
+def _vjp_fwd_seg(q, k, v, offsets, seg_q, seg_k, causal, kv_repeat,
+                 block_q, block_k, interpret):
+    out, lse, (out_padded, lse_padded, ipret, bq, bk) = _fwd_impl(
+        q, k, v, offsets, causal, kv_repeat, block_q, block_k, interpret,
+        seg_q=seg_q, seg_k=seg_k,
+    )
+    return (out, lse), (
+        q, k, v, offsets, out_padded, lse_padded, ipret, bq, bk,
+        seg_q, seg_k,
+    )
+
+
+def _bwd_impl_seg(causal, kv_repeat, block_q, block_k, interpret, res, cts):
+    dq, dk, dv, d_offsets = _bwd_impl(
+        causal, kv_repeat, block_q, block_k, interpret, res, cts
+    )
+    seg_q, seg_k = res[-2], res[-1]
+    d_seg_q = np.zeros(seg_q.shape, jax.dtypes.float0)
+    d_seg_k = np.zeros(seg_k.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_offsets, d_seg_q, d_seg_k
+
+
+_flash_core_seg.defvjp(_vjp_fwd_seg, _bwd_impl_seg)
 
 
 def _default_blocks(T: int, block_q, block_k):
@@ -545,6 +683,7 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention over (B, T, H, D) queries.
 
@@ -553,8 +692,21 @@ def flash_attention(
     accumulation order; fully differentiable (flash backward kernels).
     Off-TPU the kernels run in Pallas interpret mode.  Default blocks are
     length-adaptive (see ``_default_blocks``).
+
+    ``segment_ids`` (B, T) int32, values >= 0: packed-sequence masking —
+    tokens attend only within their own segment (causality still applies
+    on top).  The standard layout for LM pretraining feeds that pack
+    multiple documents into one row.  Packed blocks always take the
+    masked path, so packing trades the interior-block fast path for the
+    mask; unpacked calls are entirely unaffected.
     """
     block_q, block_k = _default_blocks(q.shape[1], block_q, block_k)
+    if segment_ids is not None:
+        out, _ = _flash_core_seg(
+            q, k, v, _offsets_arr(0, 0), segment_ids, segment_ids, causal,
+            kv_repeat, block_q, block_k, interpret,
+        )
+        return out
     out, _ = _flash_core(
         q, k, v, _offsets_arr(0, 0), causal, kv_repeat, block_q, block_k,
         interpret,

@@ -160,6 +160,7 @@ def sharded_local_attention(
     use_flash: bool = False,
     dp_axis: str = "dp",
     tp_axis: str = "tp",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Batch/head-sharded attention for meshes WITHOUT a sequence axis.
 
@@ -168,16 +169,19 @@ def sharded_local_attention(
     only if the computation is explicitly shard_mapped; left to GSPMD, a
     Pallas kernel is an opaque custom call and XLA would gather its operands.
     Axes that don't divide the corresponding dimension stay unsharded.
+    ``segment_ids`` (B, T): packed-sequence masking, batch-sharded like q.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ddl_tpu.ops import flash_attention
 
-    def impl(q, k, v):
+    def impl(q, k, v, seg):
         if use_flash:
-            return flash_attention(q, k, v, causal=causal, kv_repeat=kv_repeat)
-        return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat)
+            return flash_attention(q, k, v, causal=causal,
+                                   kv_repeat=kv_repeat, segment_ids=seg)
+        return attention_reference(q, k, v, causal=causal,
+                                   kv_repeat=kv_repeat, segment_ids=seg)
 
     B, _, H, _ = q.shape
     Hkv = k.shape[2]
@@ -211,12 +215,18 @@ def sharded_local_attention(
             logger.debug(
                 "sharded_local_attention: single-device mesh, local attention"
             )
-        return impl(q, k, v)
+        return impl(q, k, v, segment_ids)
     spec = P(bax, None, hax, None)
+    seg_spec = P(bax, None)
+    if segment_ids is None:
+        return shard_map(
+            lambda q, k, v: impl(q, k, v, None), mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+        )(q, k, v)
     return shard_map(
-        impl, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+        impl, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, segment_ids)
 
 
 def attention(
@@ -230,6 +240,7 @@ def attention(
     axis: str = "sp",
     dp_axis: str = "dp",
     tp_axis: str = "tp",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The single attention dispatcher — one source of truth for impl/mesh
     routing (models call this, not the individual strategies):
@@ -239,6 +250,8 @@ def attention(
     - no mesh → plain single-device attention;
     - ``impl``: "flash" / "dense" force the local kernel; "auto" uses the
       Pallas flash kernel on TPU backends and dense XLA elsewhere.
+    - ``segment_ids`` (B, T): packed-sequence masking (local strategies
+      only; the ring path does not support packing yet).
     """
     if impl not in ("auto", "flash", "dense"):
         raise ValueError(
@@ -248,6 +261,11 @@ def attention(
         impl == "auto" and jax.default_backend() == "tpu"
     )
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "segment_ids is not supported on the ring (sp) attention "
+                "path yet — pack only on dp/tp meshes"
+            )
         return ring_attention(
             q, k, v, mesh, causal=causal, axis=axis, dp_axis=dp_axis,
             kv_repeat=kv_repeat, use_flash=use_flash,
@@ -256,17 +274,25 @@ def attention(
         return sharded_local_attention(
             q, k, v, mesh, causal=causal, kv_repeat=kv_repeat,
             use_flash=use_flash, dp_axis=dp_axis, tp_axis=tp_axis,
+            segment_ids=segment_ids,
         )
     if use_flash:
         from ddl_tpu.ops import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, kv_repeat=kv_repeat)
-    return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat)
+        return flash_attention(q, k, v, causal=causal, kv_repeat=kv_repeat,
+                               segment_ids=segment_ids)
+    return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat,
+                               segment_ids=segment_ids)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "kv_repeat"))
-def attention_reference(q, k, v, causal: bool = True, kv_repeat: int = 1):
-    """Single-device full attention — the correctness oracle for tests."""
+def attention_reference(q, k, v, causal: bool = True, kv_repeat: int = 1,
+                        segment_ids=None):
+    """Single-device full attention — the correctness oracle for tests.
+
+    ``segment_ids`` (B, T): packed-sequence masking, tokens attend only
+    within their own segment (matching ``ops.flash_attention``).
+    """
     if kv_repeat > 1:
         k = jnp.repeat(k, kv_repeat, axis=2)
         v = jnp.repeat(v, kv_repeat, axis=2)
@@ -275,6 +301,10 @@ def attention_reference(q, k, v, causal: bool = True, kv_repeat: int = 1):
     if causal:
         mask = jnp.arange(T)[None, :] > jnp.arange(T)[:, None]
         s = jnp.where(mask[None, None], _NEG_INF, s)
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids)
+        segmask = seg[:, :, None] != seg[:, None, :]  # (B, Tq, Tk)
+        s = jnp.where(segmask[:, None], _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 

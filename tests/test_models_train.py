@@ -46,6 +46,55 @@ class TestLlamaModel:
             np.asarray(dense), np.asarray(flash), atol=1e-4, rtol=1e-4
         )
 
+    def test_packed_segments_isolation(self):
+        """Packed batches: perturbing document 0's tokens must not change
+        document 1's logits (flash and dense agree, both isolated)."""
+        seg = jnp.asarray(
+            np.concatenate([np.zeros(8, np.int32), np.ones(8, np.int32)])
+        )[None]
+        for impl in ("dense", "flash"):
+            cfg = llama.LlamaConfig(dtype=jnp.float32, attn_impl=impl)
+            params = llama.init_params(cfg, jax.random.key(0))
+            t1 = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab)
+            t2 = t1.at[0, :8].set(0)  # rewrite doc 0 entirely
+            l1 = llama.forward(params, t1, cfg, segment_ids=seg)
+            l2 = llama.forward(params, t2, cfg, segment_ids=seg)
+            np.testing.assert_allclose(
+                np.asarray(l1[0, 8:]), np.asarray(l2[0, 8:]),
+                rtol=1e-5, atol=1e-6,
+            )
+            assert not np.allclose(
+                np.asarray(l1[0, :8]), np.asarray(l2[0, :8])
+            )
+
+    def test_packed_loss_masks_boundaries(self):
+        """The boundary position's next-token (first token of the NEXT
+        document) is excluded from the packed loss."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab)
+        seg = jnp.asarray(
+            np.concatenate([np.zeros(8, np.int32), np.ones(8, np.int32)])
+        )[None].repeat(2, axis=0)
+        loss = llama.next_token_loss(params, tokens, cfg, segment_ids=seg)
+        assert np.isfinite(float(loss))
+        # Perturb ONLY the boundary target (first token of doc 1): packed
+        # loss must be invariant (position 7's prediction is masked and
+        # position 8's own target is position 9's token).
+        logits = llama.forward(params, tokens, cfg, segment_ids=seg)
+        from ddl_tpu.models.losses import next_token_cross_entropy
+
+        boundary = seg != jnp.roll(seg, -1, axis=1)
+        m1 = next_token_cross_entropy(logits, tokens, extra_mask=boundary)
+        t_mut = tokens.at[:, 8].set((tokens[:, 8] + 1) % cfg.vocab)
+        m2 = next_token_cross_entropy(logits, t_mut, extra_mask=boundary)
+        # Changing token 8 changes target at position 7 (masked) and
+        # target at position 8 stays tokens[9] — but token 8 is itself
+        # target of nothing else, so the masked loss shifts only through
+        # position 8's INPUT in logits; with fixed logits it is invariant
+        # except where token 8 is a target: position 7 (masked). Equal.
+        np.testing.assert_allclose(float(m1), float(m2), rtol=1e-6)
+
     def test_attn_impl_validated(self):
         with pytest.raises(ValueError, match="attn_impl"):
             llama.LlamaConfig(attn_impl="Flash")
