@@ -401,8 +401,19 @@ def _prep_seg(seg, T_padded):
     return s[:, None, :, None]
 
 
-def _seg_specs(block_q, block_k):
-    """Block specs for the (B, 1, T, 1) segment-id arrays (no head axis)."""
+def _seg_specs(block_q, block_k, transposed: bool = False):
+    """Block specs for the (B, 1, T, 1) segment-id arrays (no head axis).
+
+    ``transposed``: the dK/dV grid is (b, h, KV block, Q block), so the
+    Q-block index is grid axis 3 and the KV-block index axis 2."""
+    if transposed:
+        sq = pl.BlockSpec(
+            (1, 1, block_q, 1), lambda b, h, j, i, *_refs: (b, 0, i, 0)
+        )
+        sk = pl.BlockSpec(
+            (1, 1, block_k, 1), lambda b, h, j, i, *_refs: (b, 0, j, 0)
+        )
+        return sq, sk
     sq = pl.BlockSpec(
         (1, 1, block_q, 1), lambda b, h, i, j, *_refs: (b, 0, i, 0)
     )
@@ -554,13 +565,7 @@ def _bwd_impl(causal, kv_repeat, _block_q, _block_k, _interpret, res, cts):
                     row_spec_t, row_spec_t]
     dkv_inputs = [qt, kt, vt, dot, lse, delta, dl]
     if packed:
-        # Transposed grid: axis 2 is the KV block, axis 3 the Q block.
-        sq_spec_t = pl.BlockSpec(
-            (1, 1, block_q, 1), lambda b, h, j, i, *_refs: (b, 0, i, 0)
-        )
-        sk_spec_t = pl.BlockSpec(
-            (1, 1, block_k, 1), lambda b, h, j, i, *_refs: (b, 0, j, 0)
-        )
+        sq_spec_t, sk_spec_t = _seg_specs(block_q, block_k, transposed=True)
         dkv_in_specs += [sq_spec_t, sk_spec_t]
         dkv_inputs += [_prep_seg(seg_q, Tq), _prep_seg(seg_k, Tk)]
     dk, dv = pl.pallas_call(
