@@ -730,6 +730,8 @@ def flash_attention_with_lse(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Flash attention returning (out, logsumexp (B, H, T) fp32).
 
@@ -740,8 +742,24 @@ def flash_attention_with_lse(
     lse == -1e30; combine partial results with
     ``lse = logaddexp(lse_a, lse_b)`` and
     ``out = out_a·exp(lse_a-lse) + out_b·exp(lse_b-lse)``.
+
+    ``segment_ids`` (B, Tq) / ``kv_segment_ids`` (B, Tk; defaults to
+    ``segment_ids``): packed-sequence masking — ring attention passes its
+    local query ids and the CURRENT rotating key-block ids.
     """
     block_q, block_k = _default_blocks(q.shape[1], block_q, block_k)
+    if kv_segment_ids is not None and segment_ids is None:
+        # Key-only ids have no sound default for the queries (mirroring
+        # them silently mis-segments unpacked queries).
+        raise ValueError(
+            "kv_segment_ids requires segment_ids (the query-side ids)"
+        )
+    if segment_ids is not None:
+        seg_k = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        return _flash_core_seg(
+            q, k, v, _offsets_arr(q_offset, k_offset), segment_ids, seg_k,
+            causal, kv_repeat, block_q, block_k, interpret,
+        )
     return _flash_core(
         q, k, v, _offsets_arr(q_offset, k_offset), causal, kv_repeat,
         block_q, block_k, interpret,

@@ -31,13 +31,14 @@ _warned_replicated: set = set()  # one replicated-fallback warning per geometry
 
 
 def _block_attend(q, k, v, q_pos, k_pos, causal: bool, scale: float,
-                  kv_repeat: int = 1):
+                  kv_repeat: int = 1, seg_q=None, seg_k=None):
     """Scores and weighted values of one (Q-block, KV-block) pair.
 
     Returns (o_partial, row_max, row_sum) for online-softmax accumulation.
     q: (B, Tq, H, D); k/v: (B, Tk, H/kv_repeat, D); positions: (Tq,), (Tk,).
     GQA heads are expanded here, locally — the ring rotates the compact
     K/V, so ICI traffic stays 1/kv_repeat of the naive pre-expanded form.
+    ``seg_q``/``seg_k`` (B, Tq)/(B, Tk): packed-sequence masking.
     """
     if kv_repeat > 1:
         k = jnp.repeat(k, kv_repeat, axis=2)
@@ -46,6 +47,9 @@ def _block_attend(q, k, v, q_pos, k_pos, causal: bool, scale: float,
     if causal:
         mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
         s = jnp.where(mask, _NEG_INF, s)
+    if seg_q is not None:
+        segmask = seg_q[:, None, :, None] != seg_k[:, None, None, :]
+        s = jnp.where(segmask, _NEG_INF, s)
     m = jnp.max(s, axis=-1)  # (B, H, Tq); _NEG_INF for fully masked rows
     # Subtract a zeroed max for fully masked rows so exp() sees finite
     # arguments, and zero their probabilities — but RETURN the true max:
@@ -66,6 +70,7 @@ def ring_attention_shard(
     causal: bool = True,
     kv_repeat: int = 1,
     use_flash: bool = False,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-shard ring attention body (call under ``shard_map``).
 
@@ -79,21 +84,28 @@ def ring_attention_shard(
     kernel (global-position offsets passed in for causal masking — fully
     future blocks skip their matmuls in-kernel) and steps merge by the
     logsumexp identity; otherwise the attend is plain XLA einsums.
+
+    ``segment_ids`` (B, T_local): packed-sequence masking.  The key-side
+    ids rotate around the ring WITH their K/V blocks, so every step masks
+    the local queries against the arriving block's true document ids.
     """
     sp = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+    packed = segment_ids is not None
+    seg_k0 = segment_ids if packed else None
 
     if use_flash:
         from ddl_tpu.ops import flash_attention_with_lse
 
         def step(carry, i):
-            o_acc, lse_acc, k_cur, v_cur = carry
+            o_acc, lse_acc, k_cur, v_cur, seg_k_cur = carry
             src = (my_idx - i) % sp
             o_blk, lse_blk = flash_attention_with_lse(
                 q, k_cur, v_cur, q_offset=my_idx * T, k_offset=src * T,
                 causal=causal, kv_repeat=kv_repeat,
+                segment_ids=segment_ids, kv_segment_ids=seg_k_cur,
             )
             # Merge two normalized partials via logsumexp.  The sentinel
             # for empty rows is the finite _NEG_INF, so weights must be
@@ -110,23 +122,29 @@ def ring_attention_shard(
             o_new = o_acc * w_a + o_blk.astype(jnp.float32) * w_b
             k_next = lax.ppermute(k_cur, axis_name, perm)
             v_next = lax.ppermute(v_cur, axis_name, perm)
-            return (o_new, lse_new, k_next, v_next), None
+            seg_k_next = (
+                lax.ppermute(seg_k_cur, axis_name, perm) if packed else None
+            )
+            return (o_new, lse_new, k_next, v_next, seg_k_next), None
 
         o0 = jnp.zeros(q.shape, jnp.float32)
         lse0 = jnp.full((B, H, T), _NEG_INF, jnp.float32)
-        (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(sp))
+        (o, _, _, _, _), _ = lax.scan(
+            step, (o0, lse0, k, v, seg_k0), jnp.arange(sp)
+        )
         return o.astype(q.dtype)
 
     scale = 1.0 / (D**0.5)
     q_pos = my_idx * T + jnp.arange(T)
 
     def step(carry, i):
-        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        o_acc, m_acc, l_acc, k_cur, v_cur, seg_k_cur = carry
         # Block arriving at ring step i originated at (my_idx - i) mod sp.
         src = (my_idx - i) % sp
         k_pos = src * T + jnp.arange(T)
         o_blk, m_blk, l_blk = _block_attend(
-            q, k_cur, v_cur, q_pos, k_pos, causal, scale, kv_repeat
+            q, k_cur, v_cur, q_pos, k_pos, causal, scale, kv_repeat,
+            seg_q=segment_ids, seg_k=seg_k_cur,
         )
         m_new = jnp.maximum(m_acc, m_blk)
         alpha = jnp.exp(m_acc - m_new)  # rescale old accumulator
@@ -138,13 +156,16 @@ def ring_attention_shard(
         )
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_next, v_next), None
+        seg_k_next = (
+            lax.ppermute(seg_k_cur, axis_name, perm) if packed else None
+        )
+        return (o_new, m_new, l_new, k_next, v_next, seg_k_next), None
 
     o0 = jnp.zeros_like(q)
     m0 = jnp.full((B, H, T), _NEG_INF, dtype=q.dtype)
     l0 = jnp.zeros((B, H, T), dtype=q.dtype)
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(sp)
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, seg_k0), jnp.arange(sp)
     )
     l = jnp.maximum(l, 1e-30)
     return o / l.transpose(0, 2, 1)[..., None]
@@ -250,8 +271,8 @@ def attention(
     - no mesh → plain single-device attention;
     - ``impl``: "flash" / "dense" force the local kernel; "auto" uses the
       Pallas flash kernel on TPU backends and dense XLA elsewhere.
-    - ``segment_ids`` (B, T): packed-sequence masking (local strategies
-      only; the ring path does not support packing yet).
+    - ``segment_ids`` (B, T): packed-sequence masking on every strategy
+      (on the ring path the key-side ids rotate with their K/V blocks).
     """
     if impl not in ("auto", "flash", "dense"):
         raise ValueError(
@@ -261,14 +282,10 @@ def attention(
         impl == "auto" and jax.default_backend() == "tpu"
     )
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "segment_ids is not supported on the ring (sp) attention "
-                "path yet — pack only on dp/tp meshes"
-            )
         return ring_attention(
             q, k, v, mesh, causal=causal, axis=axis, dp_axis=dp_axis,
             kv_repeat=kv_repeat, use_flash=use_flash,
+            segment_ids=segment_ids,
         )
     if mesh is not None:
         return sharded_local_attention(
@@ -319,32 +336,42 @@ def ring_attention(
     dp_axis: Optional[str] = "dp",
     kv_repeat: int = 1,
     use_flash: bool = False,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel attention over global arrays.
 
     q: (B, T, H, D), k/v: (B, T, H/kv_repeat, D) logically global; B
     sharded over ``dp_axis`` (if present in the mesh), T sharded over
     ``axis``.  Falls back to the dense reference when the mesh has no
-    ``axis`` or it has size 1.
+    ``axis`` or it has size 1.  ``segment_ids`` (B, T): packed-sequence
+    masking; the key-side ids ride the ring with their K/V blocks.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
-        return attention_reference(q, k, v, causal=causal, kv_repeat=kv_repeat)
+        return attention_reference(q, k, v, causal=causal,
+                                   kv_repeat=kv_repeat,
+                                   segment_ids=segment_ids)
     batch_axis = dp_axis if (dp_axis and dp_axis in mesh.axis_names) else None
     spec = P(batch_axis, axis, None, None)
+    seg_spec = P(batch_axis, axis)
+    body = functools.partial(
+        ring_attention_shard,
+        axis_name=axis,
+        causal=causal,
+        kv_repeat=kv_repeat,
+        use_flash=use_flash,
+    )
+    if segment_ids is None:
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
     fn = shard_map(
-        functools.partial(
-            ring_attention_shard,
-            axis_name=axis,
-            causal=causal,
-            kv_repeat=kv_repeat,
-            use_flash=use_flash,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        lambda q, k, v, seg: body(q, k, v, segment_ids=seg),
+        mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, segment_ids)
