@@ -164,11 +164,7 @@ def forward(
 
     for layer in params["layers"]:
         h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q, k, v = _attn_qkv(layer, h, cfg, positions)
         # GQA k/v stay compact: expansion happens inside the attention
         # block, so ring attention rotates 1/rep of the bytes over ICI.
         rep = cfg.n_heads // cfg.n_kv_heads
@@ -177,14 +173,161 @@ def forward(
             kv_repeat=rep, segment_ids=segment_ids,
         )
         x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
-
-        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        x = _mlp_block(layer, x, cfg)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def _attn_qkv(layer: Params, h: jax.Array, cfg: LlamaConfig,
+              positions: jax.Array):
+    """Project + rope one block's q/k/v (shared by train and decode)."""
+    B, T = h.shape[:2]
+    dt = h.dtype
+    q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_kv_heads,
+                                             cfg.head_dim)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_kv_heads,
+                                             cfg.head_dim)
+    return (
+        _rope(q, positions, cfg.rope_theta),
+        _rope(k, positions, cfg.rope_theta),
+        v,
+    )
+
+
+def _mlp_block(layer: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """SwiGLU MLP sub-block with residual (shared by train and decode)."""
+    dt = x.dtype
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+    up = h @ layer["w_up"].astype(dt)
+    return x + (gate * up) @ layer["w_down"].astype(dt)
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Params:
+    """Per-layer KV cache buffers for autoregressive decoding."""
+    shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros((cfg.n_layers,) + shape, cfg.dtype),
+        "v": jnp.zeros((cfg.n_layers,) + shape, cfg.dtype),
+    }
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    cache: Params,
+    pos: jax.Array,
+    last_only: bool = False,
+) -> tuple[jax.Array, Params]:
+    """Process ``tokens`` (B, T) starting at position ``pos`` against a KV
+    cache (prefill: T = prompt length at pos 0; decode: T = 1).
+
+    Returns (logits, updated cache); logits are (B, T, vocab), or
+    (B, 1, vocab) with ``last_only`` (prefill wants only the frontier —
+    full-prompt fp32 logits are ~4 GB at llama3_8b/8k).  Attention is
+    dense over the cache with a causal-position mask — decode steps are
+    matmul-thin so flash buys nothing there — and attends the COMPACT
+    GQA cache via a grouped einsum (no rep-expanded cache copy in the
+    bandwidth-bound decode hot path).  The cache length is static
+    (``init_cache`` max_len) for jit-stable shapes.
+    """
+    B, T = tokens.shape
+    dt = cfg.dtype
+    L = cache["k"].shape[2]
+    positions = pos + jnp.arange(T)
+    cache_idx = jnp.arange(L)
+    x = params["embed"].astype(dt)[tokens]
+    scale = 1.0 / (cfg.head_dim**0.5)
+    rep = cfg.n_heads // cfg.n_kv_heads
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, h, cfg, positions)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"][li], k.astype(dt), (0, pos, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"][li], v.astype(dt), (0, pos, 0, 0)
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+        # Grouped-query attention against the compact cache: q regrouped
+        # per KV head, scores (B, Hkv, rep, T, L).
+        qg = q.reshape(B, T, cfg.n_kv_heads, rep, cfg.head_dim)
+        s = jnp.einsum("bqkrd,bskd->bkrqs", qg, ck) * scale
+        # Causal over absolute positions; cache slots past the frontier
+        # (zeros) are masked the same way.
+        mask = cache_idx[None, :] > positions[:, None]  # (T, L)
+        s = jnp.where(mask[None, None, None], -1e30, s)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
+        attn = jnp.einsum("bkrqs,bskd->bqkrd", p, cv)
+        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+        x = _mlp_block(layer, x, cfg)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:]
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive generation: greedy (``temperature == 0``) or
+    temperature sampling.  Returns (B, prompt_len + max_new_tokens).
+
+    Prefill runs the whole prompt in ONE cached forward (full-width
+    matmuls on the MXU); decode steps run under ``lax.scan`` with a
+    static-shape KV cache — no recompilation per step, no Python loop.
+    """
+    B, P_len = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    total = P_len + max_new_tokens
+    cache = init_cache(cfg, B, total)
+    logits, cache = forward_with_cache(
+        params, prompt, cfg, cache, jnp.int32(0), last_only=True
+    )
+    last = logits[:, -1]
+    if key is None:
+        key = jax.random.key(0)
+
+    def pick(logits_t, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits_t, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits_t / temperature, axis=-1
+        ).astype(prompt.dtype)
+
+    def step(carry, k):
+        cache, last_logits, pos = carry
+        tok = pick(last_logits, k)
+        logits_t, cache = forward_with_cache(
+            params, tok[:, None], cfg, cache, pos
+        )
+        return (cache, logits_t[:, 0], pos + 1), tok
+
+    # Scan max_new_tokens - 1 steps; the final token needs no forward of
+    # its own (its logits would be discarded).
+    keys = jax.random.split(key, max_new_tokens)
+    (_, last, _), new_tokens = jax.lax.scan(
+        step, (cache, last, jnp.int32(P_len)), keys[:-1],
+    )
+    final = pick(last, keys[-1])
+    new = jnp.concatenate(
+        [new_tokens.swapaxes(0, 1), final[:, None]], axis=1
+    ) if max_new_tokens > 1 else final[:, None]
+    return jnp.concatenate([prompt, new], axis=1)
 
 
 def next_token_loss(
