@@ -350,7 +350,4 @@ def next_token_loss(
     from ddl_tpu.models.losses import next_token_cross_entropy
 
     logits = forward(params, tokens, cfg, mesh, segment_ids=segment_ids)
-    if segment_ids is None:
-        return next_token_cross_entropy(logits, tokens)
-    boundary = segment_ids != jnp.roll(segment_ids, -1, axis=1)
-    return next_token_cross_entropy(logits, tokens, extra_mask=boundary)
+    return next_token_cross_entropy(logits, tokens, segment_ids=segment_ids)

@@ -39,18 +39,24 @@ def next_token_cross_entropy(
     logits: jax.Array,
     tokens: jax.Array,
     extra_mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Mean CE of next-token prediction over (B, T) ``tokens``.
 
     Targets are ``roll(tokens, -1)`` with the final position masked
     rather than a ``[:-1]`` slice — the sequence axis keeps its full
     length, so it stays evenly shardable over ``sp``.  ``extra_mask``
-    (B, T) True drops additional positions (e.g. packed-document
-    boundaries, where the "next token" belongs to another document).
+    (B, T) True drops additional positions.  ``segment_ids`` (packed
+    batches) drops cross-document boundary positions, where the "next
+    token" belongs to another document — the one boundary convention
+    shared by every model family.
     """
     T = tokens.shape[1]
     targets = jnp.roll(tokens, -1, axis=1)
     mask = jnp.broadcast_to((jnp.arange(T) < T - 1)[None, :], tokens.shape)
+    if segment_ids is not None:
+        boundary = segment_ids != jnp.roll(segment_ids, -1, axis=1)
+        mask = mask & jnp.logical_not(boundary)
     if extra_mask is not None:
         mask = mask & jnp.logical_not(extra_mask)
     return cross_entropy(logits, targets, mask)

@@ -188,8 +188,12 @@ def forward(
     tokens: jax.Array,
     cfg: MoeConfig,
     mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """(logits (B, T, vocab), mean router aux loss)."""
+    """(logits (B, T, vocab), mean router aux loss).
+
+    ``segment_ids`` (B, T): packed-batch attention masking, as in
+    ``models.llama.forward``."""
     from ddl_tpu.parallel.ring_attention import attention
 
     B, T = tokens.shape
@@ -200,14 +204,11 @@ def forward(
 
     for layer in params["layers"]:
         h = _llama._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        kk = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = _llama._rope(q, positions, cfg.rope_theta)
-        kk = _llama._rope(kk, positions, cfg.rope_theta)
+        q, kk, v = _llama._attn_qkv(layer, h, cfg, positions)
         rep = cfg.n_heads // cfg.n_kv_heads
         attn = attention(
-            q, kk, v, mesh=mesh, impl=cfg.attn_impl, causal=True, kv_repeat=rep
+            q, kk, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
+            kv_repeat=rep, segment_ids=segment_ids,
         )
         x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
 
@@ -226,10 +227,15 @@ def next_token_loss(
     tokens: jax.Array,
     cfg: MoeConfig,
     mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Cross-entropy + weighted router load-balance loss."""
+    """Cross-entropy + weighted router load-balance loss.
+
+    With ``segment_ids`` (packed batches), attention is segment-masked
+    and cross-document boundary predictions drop from the CE, matching
+    ``models.llama.next_token_loss``."""
     from ddl_tpu.models.losses import next_token_cross_entropy
 
-    logits, aux = forward(params, tokens, cfg, mesh)
-    ce = next_token_cross_entropy(logits, tokens)
+    logits, aux = forward(params, tokens, cfg, mesh, segment_ids=segment_ids)
+    ce = next_token_cross_entropy(logits, tokens, segment_ids=segment_ids)
     return ce + cfg.router_aux_weight * aux

@@ -113,9 +113,22 @@ def main(mode: str = "thread") -> int:
         config=cfg,
     )
     print("epoch losses:", [round(l, 4) for l in result.losses])
+
+    # Inference on the trained weights: greedy continuation via the exact
+    # KV-cache decode path (one-forward prefill + scanned decode steps).
+    prompt = jax.numpy.asarray(
+        np.memmap(token_file, np.int32, mode="r")[:16][None]
+    )
+    continued = llama.generate(
+        result.state.params, prompt, model, max_new_tokens=16
+    )
+    print("generated continuation:", np.asarray(continued[0, 16:]).tolist())
+
     ok = (
         all(np.isfinite(l) for l in result.losses)
         and result.losses[-1] < result.losses[0]
+        and continued.shape == (1, 32)
+        and int(continued.max()) < VOCAB
     )
     print("PASS" if ok else "FAIL", "- final loss", result.losses[-1])
     return 0 if ok else 1

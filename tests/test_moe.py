@@ -74,6 +74,31 @@ class TestMoeModel:
         assert np.isfinite(np.asarray(logits)).all()
         assert float(aux) > 0
 
+    def test_packed_segments_isolation(self, rng):
+        """Packed MoE batches: rewriting document 0 must not change
+        document 1's logits (segment masking reaches the MoE family).
+
+        Strict isolation needs ample expert capacity: with drops, doc-0
+        tokens compete with doc-1 tokens for capacity slots — a real
+        cross-token coupling of capacity-bounded MoE, not an attention
+        leak — so the test raises capacity_factor above the drop point.
+        """
+        cfg = _cfg(n_layers=2, capacity_factor=8.0)
+        params = moe.init_params(cfg, jax.random.key(0))
+        t1 = jnp.asarray(rng.integers(1, 64, (1, 16)), jnp.int32)
+        t2 = t1.at[0, :8].set(0)
+        seg = jnp.asarray(
+            np.concatenate([np.zeros(8, np.int32), np.ones(8, np.int32)])
+        )[None]
+        l1, _ = moe.forward(params, t1, cfg, segment_ids=seg)
+        l2, _ = moe.forward(params, t2, cfg, segment_ids=seg)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, 8:]), np.asarray(l2[0, 8:]),
+            rtol=1e-5, atol=1e-6,
+        )
+        loss = moe.next_token_loss(params, t1, cfg, segment_ids=seg)
+        assert np.isfinite(float(loss))
+
     def test_loss_decreases_on_ep_mesh(self):
         cfg = _cfg()
         mesh = make_mesh({"dp": 2, "ep": 4})
