@@ -16,6 +16,7 @@ import logging
 from typing import Any, Callable, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -78,14 +79,62 @@ class TrainState:
     step: int = 0
 
 
-def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any):
+def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any,
+                     accum_steps: int = 1):
     """One loss/grad/update/apply step — shared by the single-step and
-    multi-step (scan) factories so the update rule cannot diverge."""
+    multi-step (scan) factories so the update rule cannot diverge.
+
+    ``accum_steps > 1``: gradient accumulation — the batch splits into
+    ``accum_steps`` equal microbatches along the leading axis, grads
+    average over a ``lax.scan``, and ONE optimizer update applies.  For
+    a mean-reduction loss this is mathematically the full-batch step at
+    1/``accum_steps`` of the activation memory (the standard trade when
+    the global batch does not fit).
+    """
+
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def _micro(b: Any) -> Any:
+        if b.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch leading dim {b.shape[0]} is not divisible by "
+                f"accum_steps={accum_steps} (microbatches must be equal "
+                "for exact accumulation)"
+            )
+        return b.reshape(
+            (accum_steps, b.shape[0] // accum_steps) + b.shape[1:]
+        )
+
+    def _grads(params: Any, batch: Any):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+        micro = jax.tree.map(_micro, batch)
+
+        def body(carry, mb):
+            loss_acc, grads_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            return (
+                loss_acc + loss,
+                jax.tree.map(jnp.add, grads_acc, grads),
+            ), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params
+        )
+        (loss_sum, grads_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        inv = 1.0 / accum_steps
+        return loss_sum * inv, jax.tree.map(
+            lambda g: g * inv, grads_sum
+        )
 
     def apply_step(params: Any, opt_state: Any, batch: Any):
         import optax
 
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = _grads(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -111,6 +160,7 @@ def make_train_step(
     param_spec_tree: Any,
     batch_spec: P = P(("dp",)),
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Tuple[Callable[..., Any], Callable[..., TrainState]]:
     """Build (init_fn, step_fn) for a sharded training loop.
 
@@ -121,13 +171,17 @@ def make_train_step(
     - ``batch_spec`` — sharding of each batch leaf (default: dp over the
       leading axis; pass ``P(("dp",), "sp")`` for sequence-parallel token
       batches).
+    - ``accum_steps`` — gradient accumulation: grads average over this
+      many microbatches (leading-axis split) before ONE optimizer update
+      (see :func:`_make_apply_step`); mathematically the full-batch step
+      at a fraction of the activation memory.
 
     GSPMD derives every collective from these annotations; there is no
     hand-written psum anywhere.
     """
     param_sh = _named(mesh, param_spec_tree)
     batch_sh = _named(mesh, batch_spec)
-    apply_step = _make_apply_step(loss_fn, optimizer)
+    apply_step = _make_apply_step(loss_fn, optimizer, accum_steps)
 
     def init_fn(params: Any) -> TrainState:
         # Jitted identity, NOT device_put: device_put aliases buffers that
@@ -176,9 +230,12 @@ def make_multistep(
     batch_spec: P = P(("dp",)),
     n_steps: int = 8,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Tuple[Callable[..., Any], Callable[..., Tuple[TrainState, jax.Array]]]:
     """Like :func:`make_train_step`, but each call runs ``n_steps``
     optimizer steps chained in ONE jitted program (``lax.scan``).
+    ``accum_steps`` applies per optimizer step, as in
+    :func:`make_train_step`.
 
     One dispatch per ``n_steps`` steps: on tunneled/async backends the
     per-call dispatch overhead (tens of ms through the axon tunnel)
@@ -196,7 +253,7 @@ def make_multistep(
     init_fn, _ = make_train_step(
         loss_fn, optimizer, mesh, param_spec_tree, batch_spec=batch_spec
     )
-    apply_step = _make_apply_step(loss_fn, optimizer)
+    apply_step = _make_apply_step(loss_fn, optimizer, accum_steps)
     batch_sh = _named(mesh, batch_spec)
     per_step_sh = _named(mesh, P(*((None,) + tuple(batch_spec))))
 

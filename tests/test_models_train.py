@@ -165,6 +165,67 @@ class TestLlamaModel:
         )
 
 
+class TestGradAccumulation:
+    def test_accum_matches_full_batch_step(self):
+        """accum_steps=4 produces the same params and loss as the
+        full-batch step (mean-reduction losses make accumulation exact,
+        up to fp summation order)."""
+        import optax
+
+        cfg = pointnet.PointNetConfig(n_inputs=3, n_outputs=2)
+        mesh = make_mesh({"dp": 8})
+        rng = np.random.default_rng(0)
+        batch = tuple(
+            np.asarray(a, np.float32)
+            for a in (rng.random((32, 3)), rng.random((32, 2)),
+                      rng.random((32, 1)))
+        )
+        results = {}
+        for accum in (1, 4):
+            init_fn, step_fn = make_train_step(
+                lambda p, b: pointnet.weighted_mse_loss(p, b, cfg),
+                optax.adam(1e-2), mesh, pointnet.param_specs(cfg),
+                batch_spec=P(("dp",)), accum_steps=accum,
+            )
+            state = init_fn(pointnet.init_params(cfg, jax.random.key(0)))
+            state, loss = step_fn(state, batch)
+            results[accum] = (state, float(loss))
+        np.testing.assert_allclose(
+            results[1][1], results[4][1], rtol=1e-6
+        )
+        for a, b in zip(
+            jax.tree.leaves(results[1][0].params),
+            jax.tree.leaves(results[4][0].params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+    def test_accum_validation(self):
+        import optax
+        import pytest
+
+        cfg = pointnet.PointNetConfig(n_inputs=3, n_outputs=2)
+        mesh = make_mesh({"dp": 8})
+        with pytest.raises(ValueError, match="accum_steps"):
+            make_train_step(
+                lambda p, b: pointnet.weighted_mse_loss(p, b, cfg),
+                optax.adam(1e-2), mesh, pointnet.param_specs(cfg),
+                accum_steps=0,
+            )
+        # dp=2 so a 6-row batch passes sharding but not accum_steps=4.
+        mesh2 = make_mesh({"dp": 2}, jax.devices()[:2])
+        init_fn, step_fn = make_train_step(
+            lambda p, b: pointnet.weighted_mse_loss(p, b, cfg),
+            optax.adam(1e-2), mesh2, pointnet.param_specs(cfg),
+            batch_spec=P(("dp",)), accum_steps=4,
+        )
+        state = init_fn(pointnet.init_params(cfg, jax.random.key(0)))
+        bad = tuple(np.zeros((6, w), np.float32) for w in (3, 2, 1))
+        with pytest.raises(ValueError, match="not divisible"):
+            step_fn(state, bad)
+
+
 class TestLlamaDecode:
     def test_cached_prefill_matches_forward(self):
         """forward_with_cache over a whole prompt == plain forward."""
