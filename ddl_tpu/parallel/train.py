@@ -79,8 +79,19 @@ class TrainState:
     step: int = 0
 
 
+def _lead_extent(mesh: Any, batch_spec: P) -> int:
+    """Mesh extent sharding the batch's LEADING axis (1 if unsharded)."""
+    entry = tuple(batch_spec)[0] if tuple(batch_spec) else None
+    axes = (entry,) if isinstance(entry, str) else tuple(entry or ())
+    ext = 1
+    for a in axes:
+        if a in mesh.axis_names:
+            ext *= mesh.shape[a]
+    return ext
+
+
 def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any,
-                     accum_steps: int = 1):
+                     accum_steps: int = 1, lead_divisor: int = 1):
     """One loss/grad/update/apply step — shared by the single-step and
     multi-step (scan) factories so the update rule cannot diverge.
 
@@ -101,6 +112,15 @@ def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any,
                 f"batch leading dim {b.shape[0]} is not divisible by "
                 f"accum_steps={accum_steps} (microbatches must be equal "
                 "for exact accumulation)"
+            )
+        if (b.shape[0] // accum_steps) % lead_divisor:
+            # Not incorrect, but the dp split silently degrades: GSPMD
+            # pads/reshards each microbatch inside the scan.
+            logger.warning(
+                "gradient accumulation: microbatch size %d is not "
+                "divisible by the batch-sharding extent %d — per-"
+                "microbatch data parallelism degrades to padding/"
+                "resharding", b.shape[0] // accum_steps, lead_divisor,
             )
         return b.reshape(
             (accum_steps, b.shape[0] // accum_steps) + b.shape[1:]
@@ -181,7 +201,9 @@ def make_train_step(
     """
     param_sh = _named(mesh, param_spec_tree)
     batch_sh = _named(mesh, batch_spec)
-    apply_step = _make_apply_step(loss_fn, optimizer, accum_steps)
+    apply_step = _make_apply_step(
+        loss_fn, optimizer, accum_steps, _lead_extent(mesh, batch_spec)
+    )
 
     def init_fn(params: Any) -> TrainState:
         # Jitted identity, NOT device_put: device_put aliases buffers that
@@ -253,7 +275,9 @@ def make_multistep(
     init_fn, _ = make_train_step(
         loss_fn, optimizer, mesh, param_spec_tree, batch_spec=batch_spec
     )
-    apply_step = _make_apply_step(loss_fn, optimizer, accum_steps)
+    apply_step = _make_apply_step(
+        loss_fn, optimizer, accum_steps, _lead_extent(mesh, batch_spec)
+    )
     batch_sh = _named(mesh, batch_spec)
     per_step_sh = _named(mesh, P(*((None,) + tuple(batch_spec))))
 

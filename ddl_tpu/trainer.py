@@ -85,6 +85,7 @@ class Trainer:
         watchdog_respawn: bool = False,
         stall_budget_s: float = 300.0,
         metrics: Optional[Metrics] = None,
+        accum_steps: int = 1,
     ):
         """``loss_fn(params, batch) -> scalar`` over the loader's batch
         tuple; ``init_params`` is the initial params pytree (ignored when a
@@ -103,8 +104,10 @@ class Trainer:
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._param_specs = param_specs
+        self._accum_steps = accum_steps
         self._init_fn, self._step_fn = make_train_step(
-            loss_fn, optimizer, mesh, param_specs, batch_spec=batch_spec
+            loss_fn, optimizer, mesh, param_specs, batch_spec=batch_spec,
+            accum_steps=accum_steps,
         )
         # window_stream multistep programs, keyed by steps-per-window, so
         # repeated fit() calls on one Trainer reuse the compiled scan.
@@ -261,6 +264,7 @@ class Trainer:
                 self._loss_fn, self._optimizer, self.mesh,
                 self._param_specs, batch_spec=self._batch_spec,
                 n_steps=loader.batches_per_window,
+                accum_steps=self._accum_steps,
             )
             self._multistep_cache[loader.batches_per_window] = multi_fn
         pending = None
