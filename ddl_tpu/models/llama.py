@@ -286,6 +286,10 @@ def generate(
     """Autoregressive generation: greedy (``temperature == 0``) or
     temperature sampling.  Returns (B, prompt_len + max_new_tokens).
 
+    Sampling (``temperature > 0``) REQUIRES an explicit ``key`` — a
+    silent default would make "sampled" generation deterministically
+    identical across calls, an easy misuse trap for an inference API.
+
     Prefill runs the whole prompt in ONE cached forward (full-width
     matmuls on the MXU); decode steps run under ``lax.scan`` with a
     static-shape KV cache — no recompilation per step, no Python loop.
@@ -300,7 +304,13 @@ def generate(
     )
     last = logits[:, -1]
     if key is None:
-        key = jax.random.key(0)
+        if temperature > 0.0:
+            raise ValueError(
+                "temperature sampling requires an explicit PRNG key: "
+                "pass key=jax.random.key(seed) (every call with the "
+                "default key would sample the SAME tokens)"
+            )
+        key = jax.random.key(0)  # greedy path: keys are structural only
 
     def pick(logits_t, k):
         if temperature <= 0.0:

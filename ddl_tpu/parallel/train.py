@@ -113,15 +113,6 @@ def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any,
                 f"accum_steps={accum_steps} (microbatches must be equal "
                 "for exact accumulation)"
             )
-        if (b.shape[0] // accum_steps) % lead_divisor:
-            # Not incorrect, but the dp split silently degrades: GSPMD
-            # pads/reshards each microbatch inside the scan.
-            logger.warning(
-                "gradient accumulation: microbatch size %d is not "
-                "divisible by the batch-sharding extent %d — per-"
-                "microbatch data parallelism degrades to padding/"
-                "resharding", b.shape[0] // accum_steps, lead_divisor,
-            )
         return b.reshape(
             (accum_steps, b.shape[0] // accum_steps) + b.shape[1:]
         )
@@ -130,25 +121,48 @@ def _make_apply_step(loss_fn: Callable[..., jax.Array], optimizer: Any,
         if accum_steps == 1:
             return jax.value_and_grad(loss_fn)(params, batch)
 
+        lead = jax.tree.leaves(batch)[0].shape[0]
+        if lead % accum_steps == 0 and (lead // accum_steps) % lead_divisor:
+            # Not incorrect, but the dp split silently degrades: GSPMD
+            # pads/reshards each microbatch inside the scan.  (Checked once
+            # per trace, not per batch leaf.)
+            logger.warning(
+                "gradient accumulation: microbatch size %d is not "
+                "divisible by the batch-sharding extent %d — per-"
+                "microbatch data parallelism degrades to padding/"
+                "resharding", lead // accum_steps, lead_divisor,
+            )
         micro = jax.tree.map(_micro, batch)
+
+        # Accumulate in fp32 regardless of the params dtype: with bf16
+        # params, summing accum_steps bf16 grads rounds at every add and
+        # the "mathematically the full-batch step" equivalence degrades.
+        # Grads cast back to the param dtype after the 1/accum_steps scale
+        # so the optimizer sees the same dtypes as the unaccumulated path.
+        def acc_dtype(p: Any) -> Any:
+            d = jnp.result_type(p)
+            return jnp.float32 if jnp.issubdtype(d, jnp.inexact) else d
 
         def body(carry, mb):
             loss_acc, grads_acc = carry
             loss, grads = jax.value_and_grad(loss_fn)(params, mb)
             return (
                 loss_acc + loss,
-                jax.tree.map(jnp.add, grads_acc, grads),
+                jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), grads_acc, grads
+                ),
             ), None
 
         zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params
+            lambda p: jnp.zeros(p.shape, acc_dtype(p)), params
         )
         (loss_sum, grads_sum), _ = jax.lax.scan(
             body, (jnp.zeros((), jnp.float32), zeros), micro
         )
         inv = 1.0 / accum_steps
         return loss_sum * inv, jax.tree.map(
-            lambda g: g * inv, grads_sum
+            lambda g, p: (g * inv).astype(jnp.result_type(p)), grads_sum,
+            params,
         )
 
     def apply_step(params: Any, opt_state: Any, batch: Any):

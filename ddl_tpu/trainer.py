@@ -224,6 +224,13 @@ class Trainer:
                     vals.append(window_metric(state.params, win))
                     loader.mark(Marker.END_OF_EPOCH)
                 fvals = [float(v) for v in vals]
+                # Mean of per-window means == global batch mean ONLY
+                # because every window holds the same number of batches —
+                # an invariant the loader enforces at handshake
+                # (DistributedDataLoader rejects unequal
+                # batches_per_window, dataloader.py:103-112) and again at
+                # elastic rejoin (connection.rejoin_producer geometry
+                # check), so it cannot be violated here.
                 return sum(fvals) / len(fvals) if fvals else float("nan")
             it = loader.prefetch(2) if output == "jax" else loader
             vals: List[Any] = []

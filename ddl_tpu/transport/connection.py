@@ -19,6 +19,7 @@ from __future__ import annotations
 import abc
 import copy
 import queue as queue_mod
+import threading
 from typing import Any, List, Optional, Sequence
 
 from ddl_tpu.exceptions import StallTimeoutError, TransportError
@@ -145,6 +146,16 @@ class ConsumerConnection:
         self.rings: List[WindowRing] = []
         self.replies: List[MetaData_Producer_To_Consumer] = []
         self._sent_meta: Optional[MetaData_Consumer_To_Producer] = None
+        # Serialises the elastic-rejoin channel swap (watchdog thread,
+        # rejoin_producer) against the consumer thread's shutdown /
+        # finalize over the same lists: without it a shutdown racing an
+        # in-flight rejoin can broadcast on the just-closed predecessor
+        # channel and miss the replacement.  Ring shutdown flags are
+        # persistent state the replacement's bounded waits observe, so
+        # whichever side wins the lock, the fresh worker still exits
+        # promptly.
+        self._lock = threading.RLock()
+        self._finalized = False
 
     @property
     def n_producers(self) -> int:
@@ -210,13 +221,31 @@ class ConsumerConnection:
                 f"geometry than its predecessor"
             )
         # Swap only once the replacement validated; the dead producer's
-        # channel fd is released rather than leaked.
-        try:
-            self.channels[i].close()
-        except Exception:  # pragma: no cover - already-broken pipe
-            pass
-        self.channels[i] = channel
-        self.replies[i] = reply
+        # channel fd is released rather than leaked.  Under the lock so a
+        # concurrent shutdown/finalize sees either the old channel (still
+        # open) or the new one — never a closed-but-unswapped slot.
+        with self._lock:
+            if self._finalized:
+                # The run ended while this rejoin was in flight (e.g. the
+                # watchdog's bounded join timed out and the consumer
+                # finalized): swapping in would leak an open channel into
+                # a dead connection and report a phantom "successful"
+                # respawn.  The fresh worker exits via its ring's
+                # persistent shutdown flag.
+                try:
+                    channel.close()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
+                raise TransportError(
+                    f"rejoin of producer {producer_idx} arrived after "
+                    "finalize; dropping the replacement channel"
+                )
+            try:
+                self.channels[i].close()
+            except Exception:  # pragma: no cover - already-broken pipe
+                pass
+            self.channels[i] = channel
+            self.replies[i] = reply
         # self.rings[i] stays as-is: the consumer's attachment to the
         # surviving ring is untouched by the producer's death.
         return reply
@@ -230,30 +259,33 @@ class ConsumerConnection:
         attached (handshake failed mid-way), reachable rings are resolved
         from the recorded replies so healthy producers still wake.
         """
-        rings = self.rings
-        if not rings and self.replies:
-            rings = []
-            for r in self.replies:
-                try:
-                    rings.append(_resolve_ring(r))
-                except Exception:  # pragma: no cover - best-effort wake
-                    pass
-        for ring in rings:
-            ring.shutdown()
+        with self._lock:
+            rings = self.rings
+            if not rings and self.replies:
+                rings = []
+                for r in self.replies:
+                    try:
+                        rings.append(_resolve_ring(r))
+                    except Exception:  # pragma: no cover - best-effort wake
+                        pass
+            for ring in rings:
+                ring.shutdown()
 
     def finalize(self) -> None:
-        for ring in self.rings:
-            ring.close()
-            # Backstop cleanup: a producer that CRASHED leaves its shm
-            # name linked for elastic rejoin; if the run ends without a
-            # respawn, remove it here (idempotent — clean producers
-            # already unlinked their own).
-            try:
-                ring.unlink()
-            except Exception:  # pragma: no cover - best-effort
-                pass
-        for ch in self.channels:
-            ch.close()
+        with self._lock:
+            self._finalized = True
+            for ring in self.rings:
+                ring.close()
+                # Backstop cleanup: a producer that CRASHED leaves its shm
+                # name linked for elastic rejoin; if the run ends without a
+                # respawn, remove it here (idempotent — clean producers
+                # already unlinked their own).
+                try:
+                    ring.unlink()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
+            for ch in self.channels:
+                ch.close()
 
 
 @for_all_methods(with_logging)

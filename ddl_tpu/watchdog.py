@@ -34,6 +34,7 @@ class Watchdog:
         on_failure: Optional[Callable[[str], None]] = None,
         respawn: bool = False,
         max_respawns: int = 3,
+        replay_budget_per_window_s: float = 1.0,
     ):
         """``respawn=True`` turns detection into recovery: a dead
         producer worker is replaced in place (``WorkerSet.respawn`` —
@@ -47,6 +48,7 @@ class Watchdog:
         self.on_failure = on_failure or self._default_on_failure
         self.respawn = respawn
         self.max_respawns = max_respawns
+        self.replay_budget_per_window_s = replay_budget_per_window_s
         self.respawns: List[int] = []  # producer_idx per respawn event
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -124,11 +126,21 @@ class Watchdog:
                 self._last_progress[i] = progress
                 self._last_change[i] = now
             # A freshly respawned producer replays its predecessor's
-            # windows before committing anything — give it a much wider
-            # budget so a long replay is not mistaken for a stall.
-            budget = self.stall_budget_s * (
-                10.0 if i in self._replaying else 1.0
-            )
+            # windows before committing anything, and the default
+            # fast_forward replays one execute_function per committed
+            # window — replay time grows LINEARLY with run length.  The
+            # grace therefore scales with the recorded committed count
+            # (``replay_budget_per_window_s`` each, on top of a 10x base)
+            # instead of a fixed multiplier, so a producer dying late in
+            # a long run is not falsely escalated mid-replay.  Producers
+            # with a cheap ``fast_forward`` override (seekable sources)
+            # finish early and clear the grace on their first new commit.
+            budget = self.stall_budget_s
+            if i in self._replaying:
+                budget = self.stall_budget_s * 10.0 + (
+                    max(0.0, self._replaying[i])
+                    * self.replay_budget_per_window_s
+                )
             if (
                 self._last_progress.get(i) == progress
                 and st["committed"] == st["released"]  # producer owes one

@@ -288,6 +288,17 @@ class TestLlamaDecode:
         assert out.shape == (1, 10)
         assert int(out.max()) < cfg.vocab and int(out.min()) >= 0
 
+    def test_sampled_generate_requires_key(self):
+        """Sampling without an explicit key raises — a silent default
+        would make every 'sampled' call deterministically identical."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(4), (1, 4), 0, cfg.vocab)
+        with pytest.raises(ValueError, match="explicit PRNG key"):
+            llama.generate(
+                params, prompt, cfg, max_new_tokens=2, temperature=0.7
+            )
+
 
 class TestShardedTrainStep:
     @pytest.mark.parametrize(
