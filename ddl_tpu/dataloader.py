@@ -204,7 +204,7 @@ class DistributedDataLoader:
             put=lambda b: self._ingestor.put_batch(b, splits),
         )
 
-    def windows(self):
+    def windows(self, lookahead: int = 1):
         """Stream whole windows into HBM, one per epoch (``output="jax"``).
 
         The zero-copy ingest path: each window's transfer sources the ring
@@ -216,6 +216,19 @@ class DistributedDataLoader:
         (reference ``mpi_dataloader.py:192-193``) extended across the
         host→device boundary.
 
+        ``lookahead`` (default 1) double-buffers the stream: before window
+        k is yielded, window k+1 is acquired — holding a second slot —
+        and its transfer started, so H2D overlaps the caller's compute BY
+        CONSTRUCTION rather than by async-dispatch timing.  The reference
+        double-buffered the host-side analog only as a ToDo sketch
+        (reference ``mpi_dataloader.py:21-28``); here it spans the
+        host→device boundary.  The lookahead acquire is a NON-BLOCKING
+        try: when the producer has not committed window k+1 yet, window k
+        yields immediately and the wait happens where it always did — the
+        stream never lets producer slowness delay compute it could not
+        have hidden anyway.  Needs ``nslots >= 2`` (or >= 2 producers) to
+        take effect; ``lookahead=0`` restores strict alternation.
+
         Yields device arrays of shape ``(batches_per_window, batch_size,
         *features)``.  The caller still calls ``mark(Marker.END_OF_EPOCH)``
         after each window (Q7: one epoch == one window); batch-level
@@ -225,31 +238,90 @@ class DistributedDataLoader:
         """
         if self._ingestor is None:
             raise RuntimeError("windows() requires output='jax'")
+        import collections
+
         import jax
 
-        # Yield-bounded up front: the generator serves exactly the epochs
-        # left, so exhausting it eagerly (e.g. list()) before the marks
-        # terminates rather than streaming past the run's end.
-        for _ in range(self.n_epochs - self._epoch):
-            if self._finalized:
-                break
-            self._acquire_current()
-            assert self._cur_array is not None
-            nd = self.shapes[self._target]
+        from ddl_tpu.exceptions import StallTimeoutError
+        from ddl_tpu.profiling import annotate
+
+        held: collections.Counter = collections.Counter()
+        # FIFO of (slot, target, dev_array, samples) with transfers in
+        # flight; at most 1 + lookahead entries.
+        pending: collections.deque = collections.deque()
+
+        def start_one(timeout_s: float):
+            """Acquire the next window at the current target, start its
+            transfer, advance the rotation.  With ``held[target] > 0`` the
+            ring's drain-lookahead primitive acquires PAST the still-held
+            slot (release order stays FIFO)."""
+            target = self._target
+            ring = self.connection.rings[target]
+            with annotate("ddl.window_acquire"), self.metrics.timed(
+                "consumer.wait"
+            ):
+                slot = ring.acquire_drain_ahead(held[target], timeout_s)
+            arr = self._slot_array(target, slot)
             # Ragged tail rows (nData not a batch multiple) are unserved,
             # exactly as in batch iteration.
             served = self.batches_per_window * self.batch_size
-            window = self._cur_array[:served].reshape(
-                self.batches_per_window, self.batch_size, *nd[1:]
+            window = arr[:served].reshape(
+                self.batches_per_window, self.batch_size,
+                *self.shapes[target][1:]
             )
             dev = self._ingestor.put_window(window)
+            held[target] += 1
+            self._advance_to_next_producer()
+            return (slot, target, dev, served)
+
+        def finish(entry):
+            slot, target, dev, served = entry
             # The slot stays ours until the bytes are on device; only then
             # may the producer overwrite it.
             jax.block_until_ready(dev)
+            self.metrics.incr("consumer.windows")
             self.metrics.incr("consumer.samples", served)
-            self._release_current()
-            self._advance_to_next_producer()
-            yield dev
+            self.connection.rings[target].release(slot)
+            held[target] -= 1
+            return dev
+
+        try:
+            # Yield-bounded up front: the generator serves exactly the
+            # epochs left, so exhausting it eagerly (e.g. list()) before
+            # the marks terminates rather than streaming past the run.
+            remaining = self.n_epochs - self._epoch
+            for i in range(remaining):
+                if self._finalized:
+                    break
+                if not pending:
+                    pending.append(start_one(self.timeout_s))
+                # Deepen the pipeline up to `lookahead` extra windows, each
+                # a non-blocking try: the first not-yet-committed (or
+                # capacity-exhausted) window ends the deepening round.
+                while (
+                    len(pending) <= lookahead
+                    and i + len(pending) < remaining
+                    and not self._finalized
+                    and held[self._target]
+                    < self.connection.rings[self._target].nslots
+                ):
+                    try:
+                        pending.append(start_one(0.0))
+                    except StallTimeoutError:
+                        break  # not committed yet; wait at next iter
+                yield finish(pending.popleft())
+        finally:
+            # Early abandonment (break / close / exception): acquired-but-
+            # unyielded windows need NO ring cleanup — acquisition has no
+            # ring side effect (only release() moves the counter), so the
+            # windows stay committed and unserved.  Rewinding the rotation
+            # makes a later windows()/__getitem__ resume at exactly the
+            # next unserved window (it re-acquires the same slots).
+            # In-flight transfers are harmless: the producer cannot
+            # overwrite an unreleased slot, and slot mappings outlive
+            # close().
+            self._target = (self._target - len(pending)) % self.n_producers
+            pending.clear()
 
     # -- progress marks ------------------------------------------------------
 
@@ -290,6 +362,16 @@ class DistributedDataLoader:
     def _advance_to_next_producer(self) -> None:
         self._target = (self._target + 1) % self.n_producers
 
+    def _slot_array(self, target: int, slot: int) -> np.ndarray:
+        """Zero-copy window view of an acquired slot, shaped for ``target``."""
+        ring = self.connection.rings[target]
+        nbytes = ring.slot_payload(slot)
+        return (
+            ring.slot_view(slot)[:nbytes]
+            .view(self.dtypes[target])
+            .reshape(self.shapes[target])
+        )
+
     def _acquire_current(self) -> None:
         from ddl_tpu.profiling import annotate
 
@@ -300,12 +382,7 @@ class DistributedDataLoader:
         ):
             slot = self._ring().acquire_drain(self.timeout_s)
         self._cur_slot = slot
-        nbytes = self._ring().slot_payload(slot)
-        shape = self.shapes[self._target]
-        dtype = self.dtypes[self._target]
-        self._cur_array = (
-            self._ring().slot_view(slot)[:nbytes].view(dtype).reshape(shape)
-        )
+        self._cur_array = self._slot_array(self._target, slot)
         self.metrics.incr("consumer.windows")
 
     def fast_forward(self, n_windows: int) -> None:

@@ -161,7 +161,8 @@ ddlr_ring* ddlr_open(const char* name) {
 // hosts — the peer literally needs our timeslice), then escalating usleep
 // capped at 1ms so idle waiters cost ~nothing while handoff latency stays
 // millisecond-bounded.
-static int wait_slot(ddlr_ring* r, bool producer, int64_t timeout_us) {
+static int wait_slot(ddlr_ring* r, bool producer, int64_t timeout_us,
+                     uint32_t ahead = 0) {
   Header* h = r->hdr;
   uint64_t start = now_us();
   int spins = 0;
@@ -174,8 +175,11 @@ static int wait_slot(ddlr_ring* r, bool producer, int64_t timeout_us) {
       if (committed - released < h->nslots)
         return static_cast<int>(committed % h->nslots);
     } else {
-      if (committed > released)
-        return static_cast<int>(released % h->nslots);
+      // ahead > 0: the consumer still holds `ahead` drained-but-unreleased
+      // slots and wants the next committed one after those — the lookahead
+      // primitive behind double-buffered window streaming.
+      if (committed > released + ahead)
+        return static_cast<int>((released + ahead) % h->nslots);
     }
     uint64_t waited = now_us() - start;
     if (timeout_us >= 0 && waited > static_cast<uint64_t>(timeout_us)) {
@@ -218,6 +222,17 @@ void ddlr_commit(ddlr_ring* r, uint32_t slot, uint64_t payload_bytes) {
 int ddlr_acquire_drain(ddlr_ring* r, int64_t timeout_us) {
   uint64_t t0 = now_us();
   int s = wait_slot(r, /*producer=*/false, timeout_us);
+  add_stall(r->hdr->cons_stall_us, t0);
+  return s;
+}
+
+// Acquire the (ahead+1)-th oldest committed slot while the consumer still
+// holds `ahead` unreleased ones. Returns -3 when ahead >= nslots (the ring
+// cannot hold that many outstanding drains). Release order stays FIFO.
+int ddlr_acquire_drain_ahead(ddlr_ring* r, uint32_t ahead, int64_t timeout_us) {
+  if (ahead >= r->hdr->nslots) return -3;
+  uint64_t t0 = now_us();
+  int s = wait_slot(r, /*producer=*/false, timeout_us, ahead);
   add_stall(r->hdr->cons_stall_us, t0);
   return s;
 }

@@ -66,6 +66,20 @@ class WindowRing(abc.ABC):
     def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
         """Block until a committed slot is available; return its index."""
 
+    def acquire_drain_ahead(
+        self, ahead: int, timeout_s: float = DEFAULT_TIMEOUT_S
+    ) -> int:
+        """Acquire the next committed slot while still holding ``ahead``
+        drained-but-unreleased slots (the double-buffered window-stream
+        lookahead).  ``ahead == 0`` is exactly :meth:`acquire_drain`.
+        Slots must still be released in FIFO order.
+        """
+        if ahead == 0:
+            return self.acquire_drain(timeout_s)
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support drain lookahead"
+        )
+
     @abc.abstractmethod
     def release(self, slot: int) -> None:
         """Return a drained slot to the producer."""
@@ -164,6 +178,20 @@ class ThreadRing(WindowRing):
             lambda: self._committed > self._released, timeout_s, "_cons_stall"
         )
         return self._released % self.nslots
+
+    def acquire_drain_ahead(
+        self, ahead: int, timeout_s: float = DEFAULT_TIMEOUT_S
+    ) -> int:
+        if not 0 <= ahead < self.nslots:
+            raise ValueError(
+                f"ahead must be in [0, nslots={self.nslots}), got {ahead}"
+            )
+        self._wait(
+            lambda: self._committed > self._released + ahead,
+            timeout_s,
+            "_cons_stall",
+        )
+        return (self._released + ahead) % self.nslots
 
     def release(self, slot: int) -> None:
         with self._cond:

@@ -63,6 +63,10 @@ def _load_native() -> ctypes.CDLL:
     lib.ddlr_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
     lib.ddlr_acquire_drain.restype = ctypes.c_int
     lib.ddlr_acquire_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ddlr_acquire_drain_ahead.restype = ctypes.c_int
+    lib.ddlr_acquire_drain_ahead.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64,
+    ]
     lib.ddlr_release.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.ddlr_slot_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.ddlr_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
@@ -144,6 +148,18 @@ class NativeShmRing(WindowRing):
 
     def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
         rc = self._lib.ddlr_acquire_drain(self._h, int(timeout_s * 1e6))
+        return self._check_wait(rc, timeout_s)
+
+    def acquire_drain_ahead(
+        self, ahead: int, timeout_s: float = DEFAULT_TIMEOUT_S
+    ) -> int:
+        rc = self._lib.ddlr_acquire_drain_ahead(
+            self._h, ahead, int(timeout_s * 1e6)
+        )
+        if rc == -3:
+            raise ValueError(
+                f"ahead must be in [0, nslots={self.nslots}), got {ahead}"
+            )
         return self._check_wait(rc, timeout_s)
 
     def release(self, slot: int) -> None:
@@ -324,6 +340,20 @@ class PyShmRing(WindowRing):
         def ready():
             c, r = int(self._u64[0]), int(self._u64[1])
             return r % self.nslots if c > r else None
+
+        return self._wait(ready, timeout_s, "consumer_stall_s")
+
+    def acquire_drain_ahead(
+        self, ahead: int, timeout_s: float = DEFAULT_TIMEOUT_S
+    ) -> int:
+        if not 0 <= ahead < self.nslots:
+            raise ValueError(
+                f"ahead must be in [0, nslots={self.nslots}), got {ahead}"
+            )
+
+        def ready():
+            c, r = int(self._u64[0]), int(self._u64[1])
+            return (r + ahead) % self.nslots if c > r + ahead else None
 
         return self._wait(ready, timeout_s, "consumer_stall_s")
 
