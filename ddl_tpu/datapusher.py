@@ -34,6 +34,7 @@ from ddl_tpu.transport.connection import ProducerConnection
 from ddl_tpu.types import (
     MetaData_Consumer_To_Producer,
     MetaData_Producer_To_Consumer,
+    RunMode,
     Topology,
     normalize_splits,
 )
@@ -160,6 +161,32 @@ class DataPusher:
                     num_exchange=num_exchange,
                     exchange_method=meta.exchange_method,
                 )
+                # Fail LOUDLY at handshake when the shuffler's fabric
+                # cannot reach its exchange partners, instead of every
+                # producer stalling against a board its peers can't see
+                # (the reference's exchange ran between OS processes via
+                # MPI, reference shuffle.py:92-108 — host-side fabrics
+                # here have narrower spans and must be matched).
+                span = getattr(self.shuffler, "span", "thread")
+                if topology.mode is RunMode.MULTIHOST and span != "global":
+                    raise DoesNotMatchError(
+                        span,
+                        "host-side global shuffle cannot span hosts "
+                        "(exchange partners are other instances' "
+                        "producer processes); use the device exchange "
+                        "(ddl_tpu.parallel.DeviceGlobalShuffler over "
+                        "the instance mesh axis) for MULTIHOST runs",
+                    )
+                if connection.cross_process and span == "thread":
+                    raise DoesNotMatchError(
+                        span,
+                        "an in-process Rendezvous cannot reach producers "
+                        "in other processes (each process waits on its "
+                        "own private board until timeout); pass "
+                        "ThreadExchangeShuffler.factory(rendezvous="
+                        "ShmRendezvous(session)) with a shared session "
+                        "string, or use the device exchange",
+                    )
                 self.callbacks.append(self.shuffler)
 
         if rejoin_ring is not None:

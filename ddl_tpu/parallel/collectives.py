@@ -117,6 +117,10 @@ class DeviceGlobalShuffler:
     ``shuffle.py:92-108``.
     """
 
+    #: Fabric reach (see ddl_tpu.shuffle): XLA collectives ride ICI/DCN,
+    #: the only host-spanning exchange — MULTIHOST handshakes key on this.
+    span = "global"
+
     def __init__(
         self,
         mesh: Any,

@@ -8,13 +8,20 @@ same permutation independently from a common seed (reference
 must have no self-sends and no 2-cycles (reference ``shuffle.py:52-72``),
 except n=2 where the swap is the only option (reference ``shuffle.py:44-48``).
 
-Two transports implement the exchange:
+Three transports implement the exchange, by span:
 
-- :class:`ThreadExchangeShuffler` (here) — host-side rendezvous for
+- :class:`Rendezvous` (span ``"thread"``) — in-process board for
   THREAD-mode simulated multi-instance topologies and unit tests.
-- ``ddl_tpu.parallel.collectives`` — the TPU path: ``ppermute`` /
-  ``all_to_all`` over the instance mesh axis riding ICI/DCN, replacing the
-  reference's ``Sendrecv_replace`` (``shuffle.py:92-108``).
+- :class:`ShmRendezvous` (span ``"process"``) — /dev/shm mailbox files
+  with atomic rename, for PROCESS-mode producers in different OS
+  processes on ONE host (the reference's exchange ran between producer
+  *processes*, reference ``shuffle.py:92-108`` over ``comm_nth_pusher``).
+- ``ddl_tpu.parallel.collectives`` (span ``"global"``) — the TPU path:
+  ``ppermute`` / ``all_to_all`` over the instance mesh axis riding
+  ICI/DCN, replacing the reference's ``Sendrecv_replace``
+  (``shuffle.py:92-108``).  The ONLY host-spanning option: host-side
+  rendezvous cannot cross hosts, and ``DataPusher`` rejects that
+  combination at handshake rather than stalling.
 
 Unlike the reference — where the registered shuffler was unreachable dead
 code (SURVEY Q1) and the alternative strategy lived in a commented-out
@@ -23,8 +30,10 @@ string (Q8) — both strategies here are real, dispatched, and tested.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -95,6 +104,11 @@ class Rendezvous:
     ``ThreadExchangeShuffler.factory(rendezvous=...)`` when wiring
     multiple instances in one process (examples/global_shuffle.py)."""
 
+    #: Reach of this fabric: same-process threads only.  ``DataPusher``
+    #: rejects a "thread" rendezvous behind a cross-process connection —
+    #: each spawned worker would wait on its own private board forever.
+    span = "thread"
+
     def __init__(self) -> None:
         self._lock = threading.Condition()
         self._boxes: Dict[Tuple[int, int, int], np.ndarray] = {}
@@ -134,6 +148,101 @@ class Rendezvous:
 _default_rendezvous = Rendezvous()
 
 
+def make_session(prefix: str = "ddl") -> str:
+    """A rendezvous session name unique enough to survive crashed prior
+    runs (stale mailbox files from an old run with the same session would
+    be popped as this run's round 0)."""
+    return f"{prefix}-{os.getpid()}-{uuid.uuid4().hex[:12]}"
+
+
+class ShmRendezvous:
+    """Cross-process exchange fabric: mailbox files on /dev/shm (tmpfs).
+
+    The PROCESS-mode realisation of the reference's cross-process producer
+    exchange (reference ``shuffle.py:92-108`` rode MPI ``Sendrecv_replace``
+    between pusher processes).  Every producer process of every instance
+    on ONE host constructs ``ShmRendezvous(session)`` with the same
+    session string (the object is picklable — it carries only the string
+    — so the normal path is passing one factory through
+    ``distributed_dataloader``/``DataPusher`` spawn arguments).
+
+    Correctness needs no shared-memory ordering assumptions: ``put``
+    writes the payload to a temp file and atomically ``os.rename``s it to
+    the key's mailbox name; ``take`` polls for the name, reads, unlinks.
+    File-system syscalls give the happens-before edge, on any ISA (unlike
+    :class:`PyShmRing <ddl_tpu.transport.shm_ring.PyShmRing>`'s TSO gate).
+    Each key has exactly one writer and one reader by permutation
+    construction (no self-sends), so no further locking is needed.
+
+    NOT host-spanning: /dev/shm is per-host.  MULTIHOST topologies must
+    use the device exchange (``ddl_tpu.parallel.DeviceGlobalShuffler``);
+    ``DataPusher`` enforces this at handshake.
+    """
+
+    span = "process"
+
+    def __init__(self, session: str, root: str = "/dev/shm") -> None:
+        self.session = session
+        self.root = root
+        # Directory creation is LAZY (first put): constructing the object
+        # must be side-effect free so a handshake-time span rejection does
+        # not strand an empty session directory per failed launch.
+
+    @property
+    def _dir(self) -> str:
+        return os.path.join(self.root, f"ddl-rdv-{self.session}")
+
+    def _path(self, key: Tuple[int, int, int]) -> str:
+        return os.path.join(
+            self._dir, f"p{key[0]}-t{key[1]}-d{key[2]}.npy"
+        )
+
+    def put(self, key: Tuple[int, int, int], rows: np.ndarray) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, rows)
+        os.rename(tmp, path)  # atomic publish
+
+    def take(self, key: Tuple[int, int, int], timeout_s: float = 60.0,
+             should_abort: Optional[Callable[[], bool]] = None) -> np.ndarray:
+        """Blocking take with the same abort semantics as
+        :meth:`Rendezvous.take` (a shutting-down peer may never post)."""
+        path = self._path(key)
+        deadline = time.monotonic() + timeout_s
+        sleep_s = 0.0002
+        while True:
+            if should_abort is not None and should_abort():
+                raise ShutdownRequested()
+            try:
+                with open(path, "rb") as f:
+                    rows = np.load(f)
+                os.unlink(path)
+                return rows
+            except FileNotFoundError:
+                pass
+            if time.monotonic() > deadline:
+                raise DDLError(
+                    f"exchange rendezvous timed out waiting for {key} "
+                    f"(session {self.session!r})"
+                )
+            time.sleep(sleep_s)
+            sleep_s = min(sleep_s * 2, 0.05)
+
+    def discard(self, key: Tuple[int, int, int]) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def cleanup(self) -> None:
+        """Remove the whole session directory (post-run, best effort)."""
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
 class ThreadExchangeShuffler:
     """Producer callback performing the cross-instance exchange in-process.
 
@@ -148,7 +257,7 @@ class ThreadExchangeShuffler:
         producer_idx: int,
         num_exchange: int,
         exchange_method: str = "sendrecv_replace",
-        rendezvous: Optional[Rendezvous] = None,
+        rendezvous: Any = None,  # Rendezvous | ShmRendezvous (put/take/discard)
         seed: int = 0,
     ):
         if exchange_method not in EXCHANGE_METHODS:
@@ -162,6 +271,13 @@ class ThreadExchangeShuffler:
         self.seed = seed
         self._rdv = rendezvous or _default_rendezvous
         self._round = 0
+
+    @property
+    def span(self) -> str:
+        """Reach of the underlying rendezvous fabric ("thread"/"process"/
+        "global") — validated against the topology at the pusher
+        handshake."""
+        return getattr(self._rdv, "span", "thread")
 
     def global_shuffle(self, my_ary: np.ndarray, should_abort: Any = None,
                        **kwargs: Any) -> None:
@@ -197,20 +313,36 @@ class ThreadExchangeShuffler:
 
     # Factory signature expected by DataPusher's shuffler_factory hook.
     @classmethod
-    def factory(cls, rendezvous: Optional[Rendezvous] = None, seed: int = 0):
-        def make(
-            topology: Topology,
-            producer_idx: int,
-            num_exchange: int,
-            exchange_method: str,
-        ) -> "ThreadExchangeShuffler":
-            return cls(
-                topology,
-                producer_idx,
-                num_exchange,
-                exchange_method,
-                rendezvous=rendezvous,
-                seed=seed,
-            )
+    def factory(cls, rendezvous: Any = None, seed: int = 0):
+        return ExchangeShufflerFactory(rendezvous=rendezvous, seed=seed)
 
-        return make
+
+class ExchangeShufflerFactory:
+    """Picklable shuffler factory.
+
+    PROCESS mode ships the factory to spawned producer workers by pickle
+    (exactly like the user's producer function crosses the spawn
+    boundary), so it must be a module-level class, not a closure.  Pass a
+    :class:`ShmRendezvous` for cross-process exchange; the in-process
+    :class:`Rendezvous` is not picklable by design (its reach is one
+    process)."""
+
+    def __init__(self, rendezvous: Any = None, seed: int = 0):
+        self.rendezvous = rendezvous
+        self.seed = seed
+
+    def __call__(
+        self,
+        topology: Topology,
+        producer_idx: int,
+        num_exchange: int,
+        exchange_method: str = "sendrecv_replace",
+    ) -> ThreadExchangeShuffler:
+        return ThreadExchangeShuffler(
+            topology,
+            producer_idx,
+            num_exchange,
+            exchange_method,
+            rendezvous=self.rendezvous,
+            seed=self.seed,
+        )
