@@ -249,13 +249,26 @@ class DistributedDataLoader:
         # FIFO of (slot, target, dev_array, samples) with transfers in
         # flight; at most 1 + lookahead entries.
         pending: collections.deque = collections.deque()
+        # GENERATOR-LOCAL rotation cursor.  ``self._target`` stays the
+        # authoritative next-UNSERVED pointer and only advances when a
+        # window is actually yielded (see finish) — so abandoning this
+        # generator needs no state rollback, and a stale generator
+        # finalized by GC long after a new stream started cannot corrupt
+        # the live rotation.  Acquired-but-unyielded windows need no ring
+        # cleanup either: acquisition has no ring side effect (only
+        # release() moves the counter), so a later stream re-acquires
+        # exactly the same windows.  In-flight transfers on abandonment
+        # are harmless — the producer cannot overwrite an unreleased
+        # slot, and slot mappings outlive close().
+        cursor = self._target
 
         def start_one(timeout_s: float):
-            """Acquire the next window at the current target, start its
-            transfer, advance the rotation.  With ``held[target] > 0`` the
+            """Acquire the next window at the local cursor, start its
+            transfer, advance the cursor.  With ``held[target] > 0`` the
             ring's drain-lookahead primitive acquires PAST the still-held
             slot (release order stays FIFO)."""
-            target = self._target
+            nonlocal cursor
+            target = cursor
             ring = self.connection.rings[target]
             with annotate("ddl.window_acquire"), self.metrics.timed(
                 "consumer.wait"
@@ -271,7 +284,7 @@ class DistributedDataLoader:
             )
             dev = self._ingestor.put_window(window)
             held[target] += 1
-            self._advance_to_next_producer()
+            cursor = (cursor + 1) % self.n_producers
             return (slot, target, dev, served)
 
         def finish(entry):
@@ -283,45 +296,40 @@ class DistributedDataLoader:
             self.metrics.incr("consumer.samples", served)
             self.connection.rings[target].release(slot)
             held[target] -= 1
+            # This window is now SERVED: commit the rotation.
+            self._target = (target + 1) % self.n_producers
             return dev
 
-        try:
-            # Yield-bounded up front: the generator serves exactly the
-            # epochs left, so exhausting it eagerly (e.g. list()) before
-            # the marks terminates rather than streaming past the run.
-            remaining = self.n_epochs - self._epoch
-            for i in range(remaining):
-                if self._finalized:
+        # Yield-bounded up front: the generator serves exactly the
+        # epochs left, so exhausting it eagerly (e.g. list()) before
+        # the marks terminates rather than streaming past the run.
+        remaining = self.n_epochs - self._epoch
+        for i in range(remaining):
+            if self._finalized:
+                break
+            if not pending:
+                pending.append(start_one(self.timeout_s))
+            # Deepen the pipeline up to `lookahead` extra windows, each
+            # a non-blocking try: the first not-yet-committed (or
+            # capacity-exhausted) window ends the deepening round.
+            while (
+                len(pending) <= lookahead
+                and i + len(pending) < remaining
+                and not self._finalized
+                and held[cursor]
+                < self.connection.rings[cursor].nslots
+            ):
+                try:
+                    pending.append(start_one(0.0))
+                except StallTimeoutError:
+                    break  # not committed yet; wait at next iter
+                except NotImplementedError:
+                    # Ring without drain lookahead (a custom WindowRing
+                    # on the base-class fallback): degrade to strict
+                    # alternation instead of dying mid-stream.
+                    lookahead = 0
                     break
-                if not pending:
-                    pending.append(start_one(self.timeout_s))
-                # Deepen the pipeline up to `lookahead` extra windows, each
-                # a non-blocking try: the first not-yet-committed (or
-                # capacity-exhausted) window ends the deepening round.
-                while (
-                    len(pending) <= lookahead
-                    and i + len(pending) < remaining
-                    and not self._finalized
-                    and held[self._target]
-                    < self.connection.rings[self._target].nslots
-                ):
-                    try:
-                        pending.append(start_one(0.0))
-                    except StallTimeoutError:
-                        break  # not committed yet; wait at next iter
-                yield finish(pending.popleft())
-        finally:
-            # Early abandonment (break / close / exception): acquired-but-
-            # unyielded windows need NO ring cleanup — acquisition has no
-            # ring side effect (only release() moves the counter), so the
-            # windows stay committed and unserved.  Rewinding the rotation
-            # makes a later windows()/__getitem__ resume at exactly the
-            # next unserved window (it re-acquires the same slots).
-            # In-flight transfers are harmless: the producer cannot
-            # overwrite an unreleased slot, and slot mappings outlive
-            # close().
-            self._target = (self._target - len(pending)) % self.n_producers
-            pending.clear()
+            yield finish(pending.popleft())
 
     # -- progress marks ------------------------------------------------------
 

@@ -162,13 +162,19 @@ class DataPusher:
                     exchange_method=meta.exchange_method,
                 )
                 # Fail LOUDLY at handshake when the shuffler's fabric
-                # cannot reach its exchange partners, instead of every
-                # producer stalling against a board its peers can't see
-                # (the reference's exchange ran between OS processes via
-                # MPI, reference shuffle.py:92-108 — host-side fabrics
-                # here have narrower spans and must be matched).
-                span = getattr(self.shuffler, "span", "thread")
-                if topology.mode is RunMode.MULTIHOST and span != "global":
+                # declares a span too narrow to reach its exchange
+                # partners, instead of every producer stalling against a
+                # board its peers can't see (the reference's exchange ran
+                # between OS processes via MPI, reference
+                # shuffle.py:92-108 — host-side fabrics here have
+                # narrower spans and must be matched).  Custom shufflers
+                # WITHOUT a span attribute pass through unchecked — the
+                # guard only rejects spans it positively knows are too
+                # narrow, so pre-existing user fabrics keep working.
+                span = getattr(self.shuffler, "span", None)
+                if topology.mode is RunMode.MULTIHOST and span in (
+                    "thread", "process",
+                ):
                     raise DoesNotMatchError(
                         span,
                         "host-side global shuffle cannot span hosts "

@@ -186,6 +186,76 @@ def main(process_id: int, coordinator: str) -> None:
         assert {t // 1000 for t in tags} == {0, 1}, tags
 
     run_stream()
+
+    # ---- Window-stream FIT with PACKED SEGMENTS (VERDICT r3 item 5) ----
+    # The round-3 flagship paths under real multi-process jax.distributed
+    # (not only the single-process 8-device sim): PackedTokenProducer
+    # fills windows with (tokens | segment ids) columns, per-host windows
+    # stream into one global dp-sharded array, and a GSPMD train step
+    # runs a segment-masked llama loss on each streamed window.
+    import tempfile
+
+    from ddl_tpu.models import llama
+    from ddl_tpu.readers import PackedTokenProducer
+
+    SEQ, WINDOW_ROWS, PBATCH = 16, 16, 4
+    cfg = llama.LlamaConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=SEQ, dtype=jax.numpy.float32,
+    )
+    rng = np.random.default_rng(100 + process_id)
+    docs = [
+        rng.integers(1, 60, size=int(n)).tolist() + [0]
+        for n in rng.integers(3, 12, size=200)
+    ]
+    token_file = os.path.join(
+        tempfile.mkdtemp(prefix=f"ddl-mh-{process_id}-"), "pack.bin"
+    )
+    np.asarray([t for d in docs for t in d], np.int32).tofile(token_file)
+
+    @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+    def run_packed_stream_fit(env):
+        mesh = make_mesh({"dp": N_PROCESSES * DEVICES_PER_PROCESS})
+        loader = DistributedDataLoader(
+            PackedTokenProducer(
+                token_file, seq_len=SEQ, window_rows=WINDOW_ROWS,
+                delimiter=0,
+            ),
+            batch_size=PBATCH,
+            connection=env.connection,
+            n_epochs=2,
+            output="jax",
+            sharding=NamedSharding(mesh, P(None, "dp")),
+        )
+
+        def packed_loss(p, win):
+            tok = win[..., :SEQ].reshape(-1, SEQ)
+            seg = win[..., SEQ:].reshape(-1, SEQ)
+            return llama.next_token_loss(p, tok, cfg, segment_ids=seg)
+
+        init_fn, step_fn = make_train_step(
+            packed_loss, optax.sgd(1e-2), mesh, llama.param_specs(cfg),
+            batch_spec=P(None, ("dp",)),
+        )
+        state = init_fn(llama.init_params(cfg, jax.random.key(0)))
+        losses, saw_boundary = [], False
+        repl = NamedSharding(mesh, P())
+        gather = jax.jit(lambda x: x, out_shardings=repl)
+        for win in loader.windows():
+            assert win.shape == (
+                WINDOW_ROWS // PBATCH, N_PROCESSES * PBATCH, 2 * SEQ,
+            ), win.shape
+            segs = np.asarray(gather(win))[..., SEQ:]
+            saw_boundary = saw_boundary or bool(np.any(segs > 0))
+            state, loss = step_fn(state, win)
+            losses.append(float(loss))
+            loader.mark(Marker.END_OF_EPOCH)
+        assert len(losses) == 2 and all(np.isfinite(l) for l in losses)
+        # The packing actually packed: some row spans >1 document, so the
+        # segment mask is live (not vacuously all-zeros).
+        assert saw_boundary
+
+    run_packed_stream_fit()
     print(f"MULTIHOST OK process={process_id}", flush=True)
 
 

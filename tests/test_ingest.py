@@ -349,6 +349,35 @@ class TestLoaderPrefetch:
             1001.0, 2001.0, 1002.0, 2002.0, 1003.0, 2003.0,
         ], tags
 
+    def test_windows_stale_generator_finalize_harmless(self):
+        """A dead generator finalized LATE — after a new stream started —
+        must not corrupt the live rotation (review finding: an earlier
+        version rewound shared loader state in the generator's finally,
+        which fires at GC time, not at abandonment time)."""
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedWindowProducer(), batch_size=8,
+                connection=env.connection, n_epochs=6, output="jax",
+            )
+            it1 = loader.windows()
+            tags = [float(np.unique(np.asarray(next(it1)))[0])]
+            loader.mark(Marker.END_OF_EPOCH)
+            it2 = loader.windows()  # it1 abandoned but still referenced
+            tags.append(float(np.unique(np.asarray(next(it2)))[0]))
+            loader.mark(Marker.END_OF_EPOCH)
+            it1.close()  # stale generator finalizes only NOW
+            for win in it2:
+                tags.append(float(np.unique(np.asarray(win))[0]))
+                loader.mark(Marker.END_OF_EPOCH)
+            return tags
+
+        tags = main()
+        assert tags == [
+            1001.0, 2001.0, 1002.0, 2002.0, 1003.0, 2003.0,
+        ], tags
+
     def test_windows_deep_lookahead(self):
         """lookahead > 1 genuinely deepens the pipeline (not capped at
         one): with nslots=4 and lookahead=3 the consumer holds more than
