@@ -234,8 +234,11 @@ def _run_ingest(
 
     ``mode="process"`` runs the producers as spawned OS processes over the
     native C++ shm ring — the §2.4 native component's perf number (VERDICT
-    r2 Weak #3: it previously had none).  ``use_prefetch`` drains each
-    window via ``loader.prefetch()`` (depth-2 lookahead) instead of plain
+    r2 Weak #3: it previously had none).  On a 1-core host PROCESS trails
+    THREAD by construction (preemptive cache thrash, not ring overhead —
+    measured analysis in docs/PERF_NOTES.md); compare the two only where
+    ``nproc > n_producers``.  ``use_prefetch`` drains each window via
+    ``loader.prefetch()`` (depth-2 lookahead) instead of plain
     ``__getitem__`` iteration.
     """
     import jax

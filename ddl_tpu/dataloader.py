@@ -78,6 +78,7 @@ class DistributedDataLoader:
         self._target = 0  # index into connection.rings, round-robin
         self._cur_slot: Optional[int] = None
         self._cur_array: Optional[np.ndarray] = None
+        self._stream_token: Optional[object] = None  # active windows() stream
         self._finalized = False
         self._ingestor = None
         if output == "jax":
@@ -261,6 +262,21 @@ class DistributedDataLoader:
         # are harmless — the producer cannot overwrite an unreleased
         # slot, and slot mappings outlive close().
         cursor = self._target
+        # ONE live stream at a time: two concurrently-iterated streams
+        # would acquire the same slot (cursor and held counts are
+        # per-generator) and double-release it, silently corrupting the
+        # ring counters.  Starting a new stream therefore invalidates
+        # the previous one — its next iteration raises instead.
+        token = object()
+        self._stream_token = token
+
+        def check_live():
+            if self._stream_token is not token:
+                raise RuntimeError(
+                    "this windows() stream was superseded by a newer "
+                    "windows() call on the same loader; iterate one "
+                    "stream at a time"
+                )
 
         def start_one(timeout_s: float):
             """Acquire the next window at the local cursor, start its
@@ -305,6 +321,7 @@ class DistributedDataLoader:
         # the marks terminates rather than streaming past the run.
         remaining = self.n_epochs - self._epoch
         for i in range(remaining):
+            check_live()
             if self._finalized:
                 break
             if not pending:

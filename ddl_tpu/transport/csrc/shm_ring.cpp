@@ -27,16 +27,18 @@
 #include <cstring>
 
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <time.h>
 #include <unistd.h>
 
 namespace {
 
 constexpr uint64_t kMagic = 0xDD17B0F5A11C0DE5ULL;
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;  // v2: doorbell word in the header
 constexpr size_t kCacheLine = 64;
 
 struct alignas(kCacheLine) Header {
@@ -50,6 +52,14 @@ struct alignas(kCacheLine) Header {
   alignas(kCacheLine) std::atomic<uint64_t> committed;
   alignas(kCacheLine) std::atomic<uint64_t> released;
   alignas(kCacheLine) std::atomic<uint32_t> shutdown;
+  // Futex doorbell: every publishable event (commit, release, shutdown)
+  // increments it and wakes its waiters.  Waiters snapshot it BEFORE
+  // evaluating their predicate and park with that snapshot as `expect`,
+  // so an event landing between predicate check and park flips the word
+  // and FUTEX_WAIT returns EAGAIN — the condition-variable pattern with
+  // no lost-wake window, covering shutdown too (a flag store alone
+  // could land after a waiter's check but before it parks).
+  std::atomic<uint32_t> doorbell;
   std::atomic<uint64_t> prod_stall_us;
   std::atomic<uint64_t> cons_stall_us;
   // Variable-length: per-slot committed payload sizes, then slot payloads.
@@ -68,6 +78,25 @@ inline uint64_t now_us() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return static_cast<uint64_t>(ts.tv_sec) * 1000000ull + ts.tv_nsec / 1000;
+}
+
+// Event-driven waits via the header doorbell (replaces the original
+// 1ms-capped usleep ladder, which woke every idle producer ~1000x/s —
+// each wake preempting the consumer on 1-core hosts).  NOT
+// FUTEX_PRIVATE: waiter and waker are different processes sharing the
+// mapping.
+inline void futex_wait_on(std::atomic<uint32_t>* word, uint32_t expect,
+                          int64_t timeout_us) {
+  struct timespec ts;
+  ts.tv_sec = timeout_us / 1000000;
+  ts.tv_nsec = (timeout_us % 1000000) * 1000;
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(word), FUTEX_WAIT, expect,
+          &ts, nullptr, 0);
+}
+
+inline void futex_wake_all(std::atomic<uint32_t>* word) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(word), FUTEX_WAKE,
+          INT32_MAX, nullptr, nullptr, 0);
 }
 
 }  // namespace
@@ -156,18 +185,24 @@ ddlr_ring* ddlr_open(const char* name) {
 }
 
 // Wait until pred (expressed via counters) holds. Returns slot index >= 0,
-// -1 on timeout, -2 on shutdown. Backoff ladder: brief pause-spin (the
-// peer may be mid-commit on another core), then sched_yield (single-CPU
-// hosts — the peer literally needs our timeslice), then escalating usleep
-// capped at 1ms so idle waiters cost ~nothing while handoff latency stays
-// millisecond-bounded.
+// -1 on timeout, -2 on shutdown. Ladder: brief pause-spin (the peer may
+// be mid-commit on another core), one sched_yield round (single-CPU
+// hosts — the peer literally needs our timeslice), then an event-driven
+// futex sleep on the doorbell.  The doorbell snapshot is taken BEFORE
+// the predicate loads, so any event (commit/release/shutdown) landing
+// after the check flips the word and the park returns immediately —
+// no lost-wake window for any of the three events.  Futex chunks are
+// capped at 100ms as pure paranoia (the protocol needs no polling); in
+// the normal path the peer's wake lands in microseconds and idle
+// waiters cost ZERO periodic wakeups — the property that matters when
+// producers and consumer share one core (docs/PERF_NOTES.md).
 static int wait_slot(ddlr_ring* r, bool producer, int64_t timeout_us,
                      uint32_t ahead = 0) {
   Header* h = r->hdr;
   uint64_t start = now_us();
   int spins = 0;
-  useconds_t sleep_us = 20;
   for (;;) {
+    uint32_t bell = h->doorbell.load(std::memory_order_acquire);
     if (h->shutdown.load(std::memory_order_acquire)) return -2;
     uint64_t committed = h->committed.load(std::memory_order_acquire);
     uint64_t released = h->released.load(std::memory_order_acquire);
@@ -193,10 +228,22 @@ static int wait_slot(ddlr_ring* r, bool producer, int64_t timeout_us,
     } else if (spins < 96) {
       sched_yield();
     } else {
-      usleep(sleep_us);
-      if (sleep_us < 1000) sleep_us *= 2;
+      int64_t chunk = 100000;  // pure lost-wake paranoia, not polling
+      if (timeout_us >= 0) {
+        int64_t left = timeout_us - static_cast<int64_t>(waited);
+        if (left < chunk) chunk = left > 0 ? left : 1;
+      }
+      futex_wait_on(&h->doorbell, bell, chunk);
     }
   }
+}
+
+// Ring an event: memory effects of the event must be published (their
+// release-stores) BEFORE this increment, whose own release-store orders
+// it after them; parked waiters wake and re-evaluate.
+static void ring_doorbell(Header* h) {
+  h->doorbell.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&h->doorbell);
 }
 
 static void add_stall(std::atomic<uint64_t>& ctr, uint64_t t0) {
@@ -217,6 +264,7 @@ void ddlr_commit(ddlr_ring* r, uint32_t slot, uint64_t payload_bytes) {
   // Release-store publishes the payload and payload_bytes together.
   h->committed.store(h->committed.load(std::memory_order_relaxed) + 1,
                      std::memory_order_release);
+  ring_doorbell(h);
 }
 
 int ddlr_acquire_drain(ddlr_ring* r, int64_t timeout_us) {
@@ -242,6 +290,7 @@ void ddlr_release(ddlr_ring* r, uint32_t slot) {
   Header* h = r->hdr;
   h->released.store(h->released.load(std::memory_order_relaxed) + 1,
                     std::memory_order_release);
+  ring_doorbell(h);
 }
 
 uint8_t* ddlr_slot_ptr(ddlr_ring* r, uint32_t slot) {
@@ -256,6 +305,9 @@ uint64_t ddlr_slot_payload(ddlr_ring* r, uint32_t slot) {
 
 void ddlr_shutdown(ddlr_ring* r) {
   r->hdr->shutdown.store(1, std::memory_order_release);
+  // The doorbell snapshot/park protocol makes this wake reliable even
+  // against a waiter preempted between its flag check and its park.
+  ring_doorbell(r->hdr);
 }
 
 int ddlr_is_shutdown(ddlr_ring* r) {

@@ -378,6 +378,33 @@ class TestLoaderPrefetch:
             1001.0, 2001.0, 1002.0, 2002.0, 1003.0, 2003.0,
         ], tags
 
+    def test_windows_concurrent_streams_rejected(self):
+        """Interleaving two live windows() streams would double-release
+        ring slots (review finding): the superseded stream must raise,
+        not corrupt the counters."""
+        import pytest
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedWindowProducer(), batch_size=8,
+                connection=env.connection, n_epochs=6, output="jax",
+            )
+            it1 = loader.windows()
+            next(it1)
+            loader.mark(Marker.END_OF_EPOCH)
+            it2 = loader.windows()
+            next(it2)  # supersedes it1
+            loader.mark(Marker.END_OF_EPOCH)
+            with pytest.raises(RuntimeError, match="superseded"):
+                next(it1)
+            # The live stream keeps working.
+            next(it2)
+            loader.mark(Marker.END_OF_EPOCH)
+            loader.shutdown()
+
+        main()
+
     def test_windows_deep_lookahead(self):
         """lookahead > 1 genuinely deepens the pipeline (not capped at
         one): with nslots=4 and lookahead=3 the consumer holds more than
