@@ -23,7 +23,8 @@ killing the bench, and the JSON line is emitted even on partial failure
 with an ``errors`` field.
 
 Env knobs: DDL_BENCH_PLATFORM=tpu|cpu (skip probing), DDL_BENCH_MODE=
-ingest|train|all (default all), DDL_BENCH_PROBE_TIMEOUT_S (default 300).
+ingest|train|all|big (default all; "big" runs ONLY the HBM-filling
+train config), DDL_BENCH_PROBE_TIMEOUT_S (default 300).
 """
 
 from __future__ import annotations
@@ -342,10 +343,30 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0):
 # -- train/MFU bench ----------------------------------------------------------
 
 
-def _train_config(platform: str):
-    """MXU-saturating single-chip config on TPU; tiny on CPU."""
+def _train_config(platform: str, size: str = "small"):
+    """MXU-saturating single-chip config on TPU; tiny on CPU.
+
+    ``size="big"`` (TPU only) is the HBM-filling credibility config
+    (VERDICT r3 item 7): ~1.4B params in bf16 storage (params + adamw
+    moments ≈ 8.4 GiB of v5e's 16 GiB), per-layer remat, seq 2048 — MFU
+    at a geometry representative of the BASELINE.md 8B-class north-star
+    workloads, not a 4-layer toy.
+    """
     from ddl_tpu.models.llama import LlamaConfig
 
+    if platform == "tpu" and size == "big":
+        import jax.numpy as jnp
+
+        return (
+            LlamaConfig(
+                vocab=32768, d_model=2048, n_layers=20, n_heads=16,
+                n_kv_heads=8, d_ff=8192, max_seq=2048,
+                param_dtype=jnp.bfloat16, remat=True,
+            ),
+            4,  # batch
+            2048,  # seq
+            6,  # measured steps (~0.5-1s each: big model, remat refwd)
+        )
     if platform == "tpu":
         return (
             LlamaConfig(
@@ -382,7 +403,7 @@ def _model_flops_per_token(cfg, seq: int) -> float:
     return 3.0 * fwd
 
 
-def _run_train(platform: str, attn_impl: str):
+def _run_train(platform: str, attn_impl: str, size: str = "small"):
     """Returns dict with tokens/sec, step time, MFU for one attention impl.
 
     Timing is ``make_multistep``: all measured steps run chained inside ONE
@@ -405,7 +426,7 @@ def _run_train(platform: str, attn_impl: str):
     from ddl_tpu.parallel.mesh import make_mesh
     from ddl_tpu.parallel.train import make_multistep
 
-    cfg, batch, seq, steps = _train_config(platform)
+    cfg, batch, seq, steps = _train_config(platform, size)
     cfg = type(cfg)(**{**cfg.__dict__, "attn_impl": attn_impl})
     mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
     # mesh=None for the loss: single-chip attention needs no shard_map (and
@@ -443,8 +464,14 @@ def _run_train(platform: str, attn_impl: str):
             f"FLOPs floor {flops_per_step / peak * 1e3:.2f} ms) — "
             "timing artifact, measurement rejected"
         )
+    n_params = sum(
+        int(np.prod(np.shape(x)))
+        for x in jax.tree.leaves(state_box[0].params)
+    )
     return {
         "attn_impl": attn_impl,
+        "size": size,
+        "params_billions": round(n_params / 1e9, 3),
         "tokens_per_sec": round(tokens_per_step / dt, 1),
         "step_time_ms": round(dt * 1e3, 2),
         "model_tflops_per_sec": round(flops_per_step / dt / 1e12, 2),
@@ -787,13 +814,26 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["ingest_baseline"] = f"{type(e).__name__}: {e}"
 
-    if mode in ("train", "all"):
+    if mode in ("train", "all", "big"):
         train: dict = {}
-        for impl in ("flash", "dense") if platform == "tpu" else ("dense",):
+        impls = ("flash", "dense") if platform == "tpu" else ("dense",)
+        if mode == "big":
+            impls = ()
+        for impl in impls:
             try:
                 train[impl] = _run_train(platform, impl)
             except Exception as e:  # noqa: BLE001
                 errors[f"train_{impl}"] = f"{type(e).__name__}: {e}"
+        if platform == "tpu":
+            # HBM-filling credibility config (VERDICT r3 item 7): the MFU
+            # number README quotes, at a geometry representative of the
+            # 8B-class north-star workload.
+            try:
+                result["train_big"] = _run_train(
+                    platform, "flash", size="big"
+                )
+            except Exception as e:  # noqa: BLE001
+                errors["train_big"] = f"{type(e).__name__}: {e}"
         # BOTH impls are reported verbatim (round 2 published only the
         # "best", which was the broken measurement — VERDICT r2 item 1a).
         for impl, r in train.items():
@@ -822,21 +862,24 @@ def main() -> None:
                 train_attn_impl=best["attn_impl"],
                 device_kind=best["device_kind"],
             )
-        try:
-            impl = "flash" if platform == "tpu" else "dense"
-            fit = _run_fit(platform, impl)
-            if impl in train:
-                # End-to-end (pipeline included) vs the multistep ceiling:
-                # the input pipeline's cost on training throughput.
-                fit["pipeline_overhead"] = round(
-                    1.0
-                    - fit["tokens_per_sec"] / train[impl]["tokens_per_sec"],
-                    4,
-                )
-            result["fit_stream"] = fit
-        except Exception as e:  # noqa: BLE001
-            errors["fit_stream"] = f"{type(e).__name__}: {e}"
-        if platform == "tpu":
+        if mode != "big":
+            try:
+                impl = "flash" if platform == "tpu" else "dense"
+                fit = _run_fit(platform, impl)
+                if impl in train:
+                    # End-to-end (pipeline included) vs the multistep
+                    # ceiling: the input pipeline's cost on training
+                    # throughput.
+                    fit["pipeline_overhead"] = round(
+                        1.0
+                        - fit["tokens_per_sec"]
+                        / train[impl]["tokens_per_sec"],
+                        4,
+                    )
+                result["fit_stream"] = fit
+            except Exception as e:  # noqa: BLE001
+                errors["fit_stream"] = f"{type(e).__name__}: {e}"
+        if platform == "tpu" and mode != "big":
             try:
                 result["attn_sweep"] = _attn_sweep()
             except Exception as e:  # noqa: BLE001
@@ -848,6 +891,11 @@ def main() -> None:
         # Ingest failed but training measured: still report a headline.
         result["metric"] = "train_tokens_per_sec"
         result["value"] = result["train_tokens_per_sec"]
+        result["unit"] = "tokens/s"
+    if result["value"] is None and result.get("train_big"):
+        # Big-only mode: the big config IS the run's headline.
+        result["metric"] = "train_big_tokens_per_sec"
+        result["value"] = result["train_big"]["tokens_per_sec"]
         result["unit"] = "tokens/s"
     result["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     print(json.dumps(result))

@@ -38,6 +38,17 @@ class LlamaConfig:
     rope_theta: float = 500000.0  # Llama-3 base frequency
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    #: Storage dtype of the params pytree (fp32 master weights by
+    #: default; bf16 halves param+optimizer HBM for memory-bound
+    #: geometries — gradient accumulation stays exact either way, the
+    #: train step accumulates in fp32).
+    param_dtype: Any = jnp.float32
+    #: Rematerialise each transformer layer in the backward pass
+    #: (``jax.checkpoint``): activation memory drops from O(n_layers)
+    #: full layer internals to O(n_layers) residual-stream tensors plus
+    #: ONE layer's internals — the standard FLOPs-for-HBM trade that
+    #: lets long-sequence/big-model configs fit a single chip.
+    remat: bool = False
     # "auto": Pallas flash attention on TPU, dense elsewhere; "flash"/"dense"
     # force one path.  Sequence-parallel meshes always use ring attention.
     attn_impl: str = "auto"
@@ -67,23 +78,27 @@ class LlamaConfig:
 
 
 def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
-    """Initialise a params pytree (fp32 master weights)."""
+    """Initialise a params pytree (``cfg.param_dtype`` storage; fp32
+    master weights by default)."""
     keys = iter(jax.random.split(key, 4 + cfg.n_layers * 7))
+    pdt = cfg.param_dtype
 
     def dense(k, fan_in, shape):
-        return jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+        return (
+            jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(pdt)
 
     d, hd = cfg.d_model, cfg.head_dim
     layers = []
     for _ in range(cfg.n_layers):
         layers.append(
             {
-                "attn_norm": jnp.ones((d,), jnp.float32),
+                "attn_norm": jnp.ones((d,), pdt),
                 "wq": dense(next(keys), d, (d, cfg.n_heads * hd)),
                 "wk": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
                 "wv": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
                 "wo": dense(next(keys), cfg.n_heads * hd, (cfg.n_heads * hd, d)),
-                "mlp_norm": jnp.ones((d,), jnp.float32),
+                "mlp_norm": jnp.ones((d,), pdt),
                 "w_gate": dense(next(keys), d, (d, cfg.d_ff)),
                 "w_up": dense(next(keys), d, (d, cfg.d_ff)),
                 "w_down": dense(next(keys), cfg.d_ff, (cfg.d_ff, d)),
@@ -92,7 +107,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
     return {
         "embed": dense(next(keys), d, (cfg.vocab, d)),
         "layers": layers,
-        "final_norm": jnp.ones((d,), jnp.float32),
+        "final_norm": jnp.ones((d,), pdt),
         "lm_head": dense(next(keys), d, (d, cfg.vocab)),
     }
 
@@ -162,7 +177,7 @@ def forward(
     positions = jnp.arange(T)
     x = params["embed"].astype(dt)[tokens]  # (B, T, D)
 
-    for layer in params["layers"]:
+    def layer_fn(x: jax.Array, layer: Params) -> jax.Array:
         h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _attn_qkv(layer, h, cfg, positions)
         # GQA k/v stay compact: expansion happens inside the attention
@@ -173,7 +188,15 @@ def forward(
             kv_repeat=rep, segment_ids=segment_ids,
         )
         x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
-        x = _mlp_block(layer, x, cfg)
+        return _mlp_block(layer, x, cfg)
+
+    if cfg.remat:
+        # Save only each layer's residual-stream input; recompute the
+        # layer internals in the backward pass (HBM-for-FLOPs — the knob
+        # that fits big-model/long-seq geometries on one chip).
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        x = layer_fn(x, layer)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)

@@ -59,8 +59,10 @@ struct alignas(kCacheLine) Header {
   // and FUTEX_WAIT returns EAGAIN — the condition-variable pattern with
   // no lost-wake window, covering shutdown too (a flag store alone
   // could land after a waiter's check but before it parks).
-  std::atomic<uint32_t> doorbell;
-  std::atomic<uint64_t> prod_stall_us;
+  alignas(kCacheLine) std::atomic<uint32_t> doorbell;
+  // Stall counters on their own line: they are fetch_add'ed from both
+  // processes once per wait and must not bounce the hot doorbell line.
+  alignas(kCacheLine) std::atomic<uint64_t> prod_stall_us;
   std::atomic<uint64_t> cons_stall_us;
   // Variable-length: per-slot committed payload sizes, then slot payloads.
   // payload_bytes[i] is written by the producer before the `committed`

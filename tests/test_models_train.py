@@ -288,6 +288,40 @@ class TestLlamaDecode:
         assert out.shape == (1, 10)
         assert int(out.max()) < cfg.vocab and int(out.min()) >= 0
 
+    def test_remat_matches_plain_forward_and_grad(self):
+        """cfg.remat changes memory, NOT math: loss and grads must match
+        the plain path (it recomputes the same layer internals)."""
+        base = llama.LlamaConfig(dtype=jnp.float32)
+        rcfg = llama.LlamaConfig(dtype=jnp.float32, remat=True)
+        params = llama.init_params(base, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, base.vocab)
+
+        def loss(cfg):
+            return jax.value_and_grad(
+                lambda p: llama.next_token_loss(p, tokens, cfg)
+            )(params)
+
+        l0, g0 = loss(base)
+        l1, g1 = loss(rcfg)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            g0, g1,
+        )
+
+    def test_param_dtype_bf16_storage(self):
+        cfg = llama.LlamaConfig(param_dtype=jnp.bfloat16)
+        params = llama.init_params(cfg, jax.random.key(0))
+        assert all(
+            x.dtype == jnp.bfloat16 for x in jax.tree.leaves(params)
+        )
+        # Forward still runs and produces fp32 logits.
+        tokens = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+        out = llama.forward(params, tokens, cfg)
+        assert out.dtype == jnp.float32
+
     def test_sampled_generate_requires_key(self):
         """Sampling without an explicit key raises — a silent default
         would make every 'sampled' call deterministically identical."""
