@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# One-shot TPU validation pass (VERDICT r3 item 1): run the moment the
+# axon tunnel answers.  Batches ALL chip-dependent work front-to-back and
+# checkpoints artifacts as they land, because the tunnel is known-flaky
+# (docs/PERF_NOTES.md; memory: it has hung for 7+ hours mid-round).
+#
+# Usage: bash tools/chip_checklist.sh [artifacts_dir]
+# Steps (each tolerates failure and moves on; artifacts land per-step):
+#   1. probe   - killable subprocess probe of jax.devices()
+#   2. onchip  - DDL_TPU_ONCHIP=1 pytest tests/test_onchip.py (Mosaic-
+#                compiled flash fwd/bwd, packed segments, window-stream
+#                trainer, stream integrity)
+#   3. bench   - python bench.py (full: ingest+train+fit+sweep) -> JSON
+#   4. big     - DDL_BENCH_MODE=big python bench.py (HBM-filling MFU)
+set -u
+cd "$(dirname "$0")/.."
+ART="${1:-bench_artifacts}"
+mkdir -p "$ART"
+STAMP=$(date +%Y%m%d-%H%M%S)
+
+echo "== [1/4] probe =="
+if ! timeout 120 python -c "import jax; print(jax.devices())" \
+    > "$ART/probe-$STAMP.txt" 2>&1; then
+  echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
+  exit 1
+fi
+grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
+  echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
+echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
+
+echo "== [2/4] on-chip test suite =="
+DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
+  2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
+
+echo "== [3/4] full bench =="
+DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
+  2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
+
+echo "== [4/4] big-model MFU bench =="
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
+  2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
+
+echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="
