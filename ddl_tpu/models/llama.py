@@ -77,6 +77,14 @@ class LlamaConfig:
         return LlamaConfig()
 
 
+def _dense_init(k: jax.Array, fan_in: int, shape: Any, pdt: Any) -> jax.Array:
+    """1/sqrt(fan_in)-scaled normal init in ``pdt`` storage — shared by
+    every model family (moe reuses it like the norm/qkv blocks)."""
+    return (
+        jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+    ).astype(pdt)
+
+
 def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
     """Initialise a params pytree (``cfg.param_dtype`` storage; fp32
     master weights by default)."""
@@ -84,9 +92,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
     pdt = cfg.param_dtype
 
     def dense(k, fan_in, shape):
-        return (
-            jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
-        ).astype(pdt)
+        return _dense_init(k, fan_in, shape, pdt)
 
     d, hd = cfg.d_model, cfg.head_dim
     layers = []

@@ -55,6 +55,12 @@ class MoeConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    #: Storage dtype of the params pytree (see LlamaConfig.param_dtype —
+    #: bf16 halves param+optimizer HBM; expert stacks dominate MoE HBM).
+    param_dtype: Any = jnp.float32
+    #: Per-layer jax.checkpoint (see LlamaConfig.remat); the capacity-
+    #: bounded dispatch/combine einsums are the big activations here.
+    remat: bool = False
     attn_impl: str = "auto"
 
     @property
@@ -79,22 +85,24 @@ class MoeConfig:
 
 
 def init_params(cfg: MoeConfig, key: jax.Array) -> Params:
-    keys = iter(jax.random.split(key, 2 + cfg.n_layers * 9))
+    # 8 dense draws per layer + embed + lm_head.
+    keys = iter(jax.random.split(key, 2 + cfg.n_layers * 8))
+    pdt = cfg.param_dtype
 
     def dense(k, fan_in, shape):
-        return jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+        return _llama._dense_init(k, fan_in, shape, pdt)
 
     d, hd, E, F = cfg.d_model, cfg.head_dim, cfg.n_experts, cfg.d_ff
     layers = []
     for _ in range(cfg.n_layers):
         layers.append(
             {
-                "attn_norm": jnp.ones((d,), jnp.float32),
+                "attn_norm": jnp.ones((d,), pdt),
                 "wq": dense(next(keys), d, (d, cfg.n_heads * hd)),
                 "wk": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
                 "wv": dense(next(keys), d, (d, cfg.n_kv_heads * hd)),
                 "wo": dense(next(keys), cfg.n_heads * hd, (cfg.n_heads * hd, d)),
-                "mlp_norm": jnp.ones((d,), jnp.float32),
+                "mlp_norm": jnp.ones((d,), pdt),
                 "w_router": dense(next(keys), d, (d, E)),
                 "w_gate": dense(next(keys), d, (E, d, F)),
                 "w_up": dense(next(keys), d, (E, d, F)),
@@ -104,7 +112,7 @@ def init_params(cfg: MoeConfig, key: jax.Array) -> Params:
     return {
         "embed": dense(next(keys), d, (cfg.vocab, d)),
         "layers": layers,
-        "final_norm": jnp.ones((d,), jnp.float32),
+        "final_norm": jnp.ones((d,), pdt),
         "lm_head": dense(next(keys), d, (d, cfg.vocab)),
     }
 
@@ -202,7 +210,7 @@ def forward(
     x = params["embed"].astype(dt)[tokens]
     aux_total = jnp.zeros((), jnp.float32)
 
-    for layer in params["layers"]:
+    def layer_fn(x: jax.Array, layer: Params):
         h = _llama._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, kk, v = _llama._attn_qkv(layer, h, cfg, positions)
         rep = cfg.n_heads // cfg.n_kv_heads
@@ -214,7 +222,15 @@ def forward(
 
         h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         moe_out, aux = moe_mlp(h.reshape(B * T, -1), layer, cfg)
-        x = x + moe_out.reshape(B, T, -1)
+        return x + moe_out.reshape(B, T, -1), aux
+
+    if cfg.remat:
+        # Save only each layer's residual-stream input; recompute the
+        # routing/dispatch/expert internals in the backward pass (see
+        # LlamaConfig.remat).
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        x, aux = layer_fn(x, layer)
         aux_total = aux_total + aux
 
     x = _llama._rms_norm(x, params["final_norm"], cfg.norm_eps)

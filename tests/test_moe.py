@@ -74,6 +74,40 @@ class TestMoeModel:
         assert np.isfinite(np.asarray(logits)).all()
         assert float(aux) > 0
 
+    def test_remat_matches_plain_forward_and_grad(self, rng):
+        """cfg.remat trades memory for FLOPs, not math: loss and grads
+        must match the plain path through routing and dispatch."""
+        base = _cfg(n_layers=2)
+        rcfg = _cfg(n_layers=2, remat=True)
+        params = moe.init_params(base, jax.random.key(0))
+        tokens = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+
+        def loss(cfg):
+            return jax.value_and_grad(
+                lambda p: moe.next_token_loss(p, tokens, cfg)
+            )(params)
+
+        l0, g0 = loss(base)
+        l1, g1 = loss(rcfg)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            g0, g1,
+        )
+
+    def test_param_dtype_bf16_storage(self, rng):
+        cfg = _cfg(param_dtype=jnp.bfloat16, dtype=jnp.bfloat16)
+        params = moe.init_params(cfg, jax.random.key(0))
+        assert all(
+            x.dtype == jnp.bfloat16 for x in jax.tree.leaves(params)
+        )
+        tokens = jnp.asarray(rng.integers(0, 64, (1, 8)), jnp.int32)
+        logits, aux = moe.forward(params, tokens, cfg)
+        assert logits.dtype == jnp.float32
+        assert np.isfinite(np.asarray(logits)).all()
+
     def test_packed_segments_isolation(self, rng):
         """Packed MoE batches: rewriting document 0 must not change
         document 1's logits (segment masking reaches the MoE family).
