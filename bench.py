@@ -286,13 +286,19 @@ def _run_ingest(
     )
 
 
-def _run_ingest_stream(link_bytes_per_sec: float = 0.0):
+def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
     """The zero-copy streaming path: ``loader.windows()`` transfers whole
     windows straight out of ring slots (no host memcpy between producer
     fill and HBM), producers fill slots in place.  This is the config that
     evaluates BASELINE.md's ">=90% bandwidth utilization" target — per-
     batch per-column puts can never reach it on a link with fixed
-    per-transfer cost (measured: tools/probe_ingest.py)."""
+    per-transfer cost (measured: tools/probe_ingest.py).
+
+    ``mode="process"`` is the production shape on a real TPU host:
+    producer processes fill native shm ring slots on their own cores
+    while the consumer streams slots into HBM (on the 1-core bench box
+    it trails THREAD for the docs/PERF_NOTES.md reasons).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -307,7 +313,7 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0):
     def consume(w):
         return jnp.sum(w[..., -1])
 
-    @distributed_dataloader(n_producers=N_PRODUCERS, mode="thread", nslots=2)
+    @distributed_dataloader(n_producers=N_PRODUCERS, mode=mode, nslots=2)
     def main(env):
         loader = DistributedDataLoader(
             StreamBenchProducer(), batch_size=BATCH,
@@ -754,38 +760,48 @@ def main() -> None:
             }
         except Exception as e:  # noqa: BLE001
             errors["ingest_no_prefetch"] = f"{type(e).__name__}: {e}"
+        def _stream_result(stream_mode: str) -> dict:
+            """One gated best-of stream measurement for ``stream_mode``
+            (shared by the thread and process configs so the utilization
+            gate cannot be dropped from one of them)."""
+
+            def run():
+                rate, ns = _run_ingest_stream(link_bw, mode=stream_mode)
+                if link_bw:
+                    _gate_utilization(ns, f"stream-{stream_mode}")
+                return rate, ns
+
+            rate, ns = best_valid(2, run, key=lambda r: -r[0])
+            return {
+                "samples_per_sec": round(rate, 1),
+                "window_mib": round(N_DATA_STREAM * N_VALUES * 4 / 2**20, 1),
+                "bytes_per_sec": round(ns["ingest_bytes_per_sec"], 1),
+                "stall_fraction": round(ns["stall_fraction"], 4),
+                "bandwidth_utilization": round(
+                    ns.get("bandwidth_utilization", 0.0), 4
+                ),
+            }
+
         try:
             # Zero-copy window streaming (loader.windows + inplace fill):
             # the bandwidth-utilization headline config.
-            def _stream_run():
-                rate, ns = _run_ingest_stream(link_bw)
-                if link_bw:
-                    _gate_utilization(ns, "stream")
-                return rate, ns
-
-            stream, ns_stream = best_valid(
-                2, _stream_run, key=lambda r: -r[0]
-            )
-            result["ingest_stream"] = {
-                "samples_per_sec": round(stream, 1),
-                "window_mib": round(
-                    N_DATA_STREAM * N_VALUES * 4 / 2**20, 1
-                ),
-                "bytes_per_sec": round(ns_stream["ingest_bytes_per_sec"], 1),
-                "stall_fraction": round(ns_stream["stall_fraction"], 4),
-                "bandwidth_utilization": round(
-                    ns_stream.get("bandwidth_utilization", 0.0), 4
-                ),
-            }
-            if ns_stream.get("bandwidth_utilization", 0.0) > (
+            result["ingest_stream"] = _stream_result("thread")
+            if result["ingest_stream"]["bandwidth_utilization"] > (
                 result.get("bandwidth_utilization") or 0.0
             ):
-                result["bandwidth_utilization"] = round(
-                    ns_stream["bandwidth_utilization"], 4
-                )
+                result["bandwidth_utilization"] = result["ingest_stream"][
+                    "bandwidth_utilization"
+                ]
                 result["bandwidth_utilization_config"] = "stream"
         except Exception as e:  # noqa: BLE001
             errors["ingest_stream"] = f"{type(e).__name__}: {e}"
+        try:
+            # Stream over PROCESS-mode producers: the production shape on
+            # a multi-core TPU host (fills on producer cores, consumer
+            # core streams slots to HBM).
+            result["ingest_stream_process"] = _stream_result("process")
+        except Exception as e:  # noqa: BLE001
+            errors["ingest_stream_process"] = f"{type(e).__name__}: {e}"
         try:
             # PROCESS mode: spawned producer processes over the native C++
             # shm ring — the native transport's throughput number.
