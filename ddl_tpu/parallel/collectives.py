@@ -161,3 +161,39 @@ class DeviceGlobalShuffler:
             )
         self._round += 1
         return step(window)
+
+    def window_hook(self):
+        """Adapter for ``Trainer.fit(window_stream=True, window_hook=)``.
+
+        The trainer streams windows shaped ``(batches_per_window, batch,
+        *features)`` sharded ``P(None, dp, ...)``; :meth:`shuffle` wants
+        rows-leading ``P(dp)``.  The returned hook flattens to sample
+        rows (batch-major, so contiguous dp blocks stay contiguous),
+        reshardes, exchanges, and restores the window layout/sharding —
+        making the device exchange a drop-in per-window transform for
+        streamed training.  Runs OUTSIDE jit on concrete arrays; every
+        op inside is jitted/XLA.
+
+        NOTE for checkpoint/resume: the shuffler's round counter is
+        state.  A resumed run must restore it (``LoaderCheckpoint.
+        capture(loader, shuffler=...)`` / ``.apply``) or post-resume
+        rounds replay the round-0 permutations.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row_sh = NamedSharding(self.mesh, P(self.axis))
+
+        def hook(win: Any) -> Any:
+            bpw, batch = win.shape[0], win.shape[1]
+            feat = win.shape[2:]
+            win_sh = getattr(win, "sharding", None)
+            rows = jnp.swapaxes(win, 0, 1).reshape(batch * bpw, -1)
+            mixed = self.shuffle(jax.device_put(rows, row_sh))
+            back = jnp.swapaxes(
+                mixed.reshape((batch, bpw) + feat), 0, 1
+            )
+            return jax.device_put(back, win_sh) if win_sh else back
+
+        return hook

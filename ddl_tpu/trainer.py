@@ -254,6 +254,7 @@ class Trainer:
         start_epoch: int,
         n_epochs: int,
         epoch_losses: List[float],
+        window_hook: Any = None,
     ) -> FitResult:
         """One multistep scan per streamed window (see ``fit`` docstring).
 
@@ -277,6 +278,8 @@ class Trainer:
         pending = None
         epoch = start_epoch
         for win in loader.windows():
+            if window_hook is not None:
+                win = window_hook(win)
             state, losses = multi_fn(
                 state, _window_cols(win, col_splits), per_step=True
             )
@@ -321,6 +324,7 @@ class Trainer:
         loader_kwargs: Optional[dict] = None,
         prefetch_depth: int = 2,
         window_stream: Optional[bool] = None,
+        window_hook: Any = None,
         config: Any = None,
     ) -> FitResult:
         """Run the full producer/consumer training job; returns FitResult.
@@ -343,6 +347,13 @@ class Trainer:
         instead of one of each per batch, with the next window streaming
         while the scan computes.  The optimizer-step sequence is exactly
         the per-batch path's, so results match batch-mode ``fit``.
+
+        ``window_hook`` (window-stream mode only): a callable applied to
+        each drained device window before its train scan — the trainer-
+        side extension point for DEVICE-side transforms, e.g. a
+        cross-instance ``DeviceGlobalShuffler`` exchange (which, unlike
+        the producer-side host exchange, composes with elastic respawn:
+        no producer carries exchange state).  Must be shape-preserving.
 
         Under PROCESS/MULTIHOST modes call this from under
         ``if __name__ == "__main__":`` (multiprocessing spawn re-imports
@@ -384,6 +395,8 @@ class Trainer:
         window_stream = bool(window_stream)
         if window_stream and output != "jax":
             raise ValueError("window_stream requires output='jax'")
+        if window_hook is not None and not window_stream:
+            raise ValueError("window_hook requires window_stream=True")
         global_shuffle_fraction_exchange = (
             global_shuffle_fraction_exchange or 0.0
         )
@@ -462,7 +475,8 @@ class Trainer:
             if window_stream:
                 try:
                     return trainer._fit_windows(
-                        loader, state, start_epoch, n_epochs, epoch_losses
+                        loader, state, start_epoch, n_epochs, epoch_losses,
+                        window_hook=window_hook,
                     )
                 finally:
                     if wd is not None:

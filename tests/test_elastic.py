@@ -96,6 +96,63 @@ def test_crash_respawn_data_continuity(mode, tmp_path):
     assert os.path.exists(sentinel)  # the crash really fired
 
 
+def test_elastic_respawn_composes_with_device_shuffle(tmp_path):
+    """Elastic recovery and global shuffle are NOT mutually exclusive
+    when the shuffle runs DEVICE-side: the trainer applies
+    DeviceGlobalShuffler to drained windows on the dp mesh, so a
+    producer respawn never touches any exchange schedule.  (Only the
+    HOST-side producer exchange is rejected together with rejoin —
+    datapusher handshake; docs/API.md design note.)"""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddl_tpu.parallel import DeviceGlobalShuffler
+    from ddl_tpu.parallel.mesh import make_mesh
+
+    sentinel = str(tmp_path / "crash-dev-shuffle")
+
+    @distributed_dataloader(n_producers=1, mode="thread")
+    def main(env):
+        wd = Watchdog(
+            env.workers, poll_interval_s=0.2, stall_budget_s=60.0,
+            respawn=True,
+        ).start()
+        mesh = make_mesh({"dp": 8})
+        shuffler = DeviceGlobalShuffler(mesh, num_exchange=2, seed=5)
+        row_sh = NamedSharding(mesh, P("dp"))
+        try:
+            loader = DistributedDataLoader(
+                CrashOnceProducer(sentinel), batch_size=16,
+                connection=env.connection, n_epochs=6, output="jax",
+                timeout_s=120.0,
+            )
+            tags = []
+            for win in loader.windows():
+                # Tag each row uniquely (window*100 + row) so the
+                # conservation assertion has teeth: a shuffle that drops,
+                # duplicates, or never exchanges rows FAILS it.
+                host = np.asarray(win).reshape(16, 4).copy()
+                tags.append(float(host[0, 0]))
+                host[:, 0] = host[0, 0] * 100 + np.arange(16)
+                rows = jax.device_put(host, row_sh)
+                mixed = np.asarray(shuffler.shuffle(rows))
+                assert sorted(mixed[:, 0].tolist()) == sorted(
+                    host[:, 0].tolist()
+                )
+                # Rows actually moved across dp shard blocks.
+                assert not np.array_equal(mixed[:, 0], host[:, 0])
+                loader.mark(Marker.END_OF_EPOCH)
+        finally:
+            wd.stop()
+        return tags, list(wd.respawns), list(wd.failures)
+
+    tags, respawns, failures = main()
+    assert tags == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0], tags
+    assert respawns == [1], respawns
+    assert failures == [], failures
+    assert os.path.exists(sentinel)  # the crash really fired
+
+
 class HangOnceProducer(ProducerFunctionSkeleton):
     """Serves windows tagged 1,2,3,... and HANGS (rather than dying) once
     at ``hang_at`` — first incarnation only, gated by the sentinel file.

@@ -68,6 +68,51 @@ def test_fit_checkpoint_and_resume(rng, tmp_path):
     assert float(np.abs(np.asarray(mu)).max()) > 0
 
 
+def test_fit_window_hook_runs_per_window(rng):
+    """window_hook is the device-side per-window extension point (e.g. a
+    DeviceGlobalShuffler exchange): called once per streamed window,
+    applied before the scan, stream-mode only."""
+    import jax.numpy as jnp
+    import pytest
+
+    calls = []
+
+    def hook(win):
+        calls.append(win.shape)
+        return jnp.flip(win, axis=1)  # shape-preserving row transform
+
+    _, trainer = _make_trainer()
+    res = trainer.fit(
+        _producer(rng), batch_size=16, n_epochs=3, n_producers=2,
+        mode="thread", output="jax", window_stream=True,
+        window_hook=hook,
+    )
+    assert len(calls) == 3 and all(np.isfinite(l) for l in res.losses)
+    with pytest.raises(ValueError, match="window_hook"):
+        trainer.fit(
+            _producer(rng), batch_size=16, n_epochs=1, n_producers=2,
+            mode="thread", output="jax", window_hook=hook,
+        )
+
+
+def test_fit_window_hook_device_shuffler(rng):
+    """THE documented composition (docs/API.md): DeviceGlobalShuffler's
+    window_hook() adapter through the streamed Trainer — one exchange
+    round per window, training stays finite."""
+    from ddl_tpu.parallel import DeviceGlobalShuffler
+
+    _, trainer = _make_trainer()
+    sh = DeviceGlobalShuffler(trainer.mesh, num_exchange=2, seed=3)
+    res = trainer.fit(
+        _producer(rng), batch_size=16, n_epochs=3, n_producers=2,
+        mode="thread", output="jax", window_stream=True,
+        window_hook=sh.window_hook(),
+    )
+    assert sh._round == 3  # one exchange round per streamed window
+    assert len(res.losses) == 3
+    assert all(np.isfinite(l) for l in res.losses)
+
+
 def test_fit_jax_output(rng):
     """output='jax': batches land on device via the ingest path."""
     _, trainer = _make_trainer()
