@@ -140,7 +140,9 @@ class Trainer:
         )
         return state, start_epoch
 
-    def _checkpoint(self, state: Any, loader: Any) -> None:
+    def _checkpoint(
+        self, state: Any, loader: Any, shuffler: Any = None
+    ) -> None:
         # Producer-side shuffler rounds need no explicit capture: on resume
         # ``fit`` replays the consumed windows (``loader.fast_forward``) and
         # the producers re-execute their deterministic schedule — including
@@ -153,7 +155,9 @@ class Trainer:
 
         assert self.checkpoint_dir is not None
         save_train_state(state, self.checkpoint_dir)
-        LoaderCheckpoint.capture(loader).save(self._loader_ckpt_path())
+        LoaderCheckpoint.capture(loader, shuffler=shuffler).save(
+            self._loader_ckpt_path()
+        )
 
     # -- evaluation --------------------------------------------------------
 
@@ -255,6 +259,7 @@ class Trainer:
         n_epochs: int,
         epoch_losses: List[float],
         window_hook: Any = None,
+        hook_state: Any = None,
     ) -> FitResult:
         """One multistep scan per streamed window (see ``fit`` docstring).
 
@@ -292,7 +297,7 @@ class Trainer:
                 self.checkpoint_dir is not None
                 and epoch % self.checkpoint_every_epochs == 0
             ):
-                self._checkpoint(state, loader)
+                self._checkpoint(state, loader, shuffler=hook_state)
         if pending is not None:
             epoch_losses.append(float(pending.mean()))
         for i, mean in enumerate(epoch_losses):
@@ -397,6 +402,14 @@ class Trainer:
             raise ValueError("window_stream requires output='jax'")
         if window_hook is not None and not window_stream:
             raise ValueError("window_hook requires window_stream=True")
+        # A stateful hook provider (DeviceGlobalShuffler or anything with
+        # a .window_hook() factory) is passed WHOLE so the trainer can
+        # checkpoint/restore its round state with the loader clock —
+        # a bare callable hook is the caller's responsibility to resume.
+        hook_state = None
+        if window_hook is not None and hasattr(window_hook, "window_hook"):
+            hook_state = window_hook
+            window_hook = hook_state.window_hook()
         global_shuffle_fraction_exchange = (
             global_shuffle_fraction_exchange or 0.0
         )
@@ -460,7 +473,10 @@ class Trainer:
                 # deterministically, so resumed epochs see the DATA they
                 # would have seen, not a replay of epoch 0.
                 loader.fast_forward(ck.epoch)
-                ck.apply(loader)
+                # shuffler=hook_state also restores a device shuffler's
+                # round counter, so post-resume exchange permutations
+                # continue the schedule instead of replaying round 0.
+                ck.apply(loader, shuffler=hook_state)
             wd = None
             if trainer.watchdog_enabled and env.workers is not None:
                 # respawn=True turns failure detection into elastic
@@ -476,7 +492,7 @@ class Trainer:
                 try:
                     return trainer._fit_windows(
                         loader, state, start_epoch, n_epochs, epoch_losses,
-                        window_hook=window_hook,
+                        window_hook=window_hook, hook_state=hook_state,
                     )
                 finally:
                     if wd is not None:
