@@ -278,12 +278,19 @@ def _run_ingest(
                 loader.mark(Marker.END_OF_BATCH)
             loader.mark(Marker.END_OF_EPOCH)
         jax.block_until_ready(out)
-        return samples / (time.perf_counter() - t0)
+        # Snapshot the north-star report at the SAME instant the wall
+        # clock stops — still inside the consumer role, BEFORE the
+        # decorator's producer teardown.  Computing it after main()
+        # returned let Metrics.elapsed_s() run through worker joins,
+        # deflating bytes/s by the teardown time (seconds in PROCESS
+        # mode), so process runs could report more samples/s yet fewer
+        # bytes/s than thread runs (VERDICT r4 Weak #3).
+        rate = samples / (time.perf_counter() - t0)
+        return rate, north_star_report(
+            metrics, link_bytes_per_sec=link_bytes_per_sec
+        )
 
-    rate = main()
-    return rate, north_star_report(
-        metrics, link_bytes_per_sec=link_bytes_per_sec
-    )
+    return main()
 
 
 def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
@@ -338,12 +345,18 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
             seen += 1
             loader.mark(Marker.END_OF_EPOCH)
         jax.block_until_ready(out)
-        return samples / (time.perf_counter() - t0)
+        # Same-span report (see _run_ingest): stop both clocks here,
+        # inside the consumer role, so teardown time cannot leak into the
+        # registry rates.  With the stream path's completion-time byte
+        # accounting (DeviceIngestor.put_window defer_metrics), registry
+        # bytes and wall-clock samples now cover identical windows:
+        # bytes/s == samples/s * bytes_per_sample by construction.
+        rate = samples / (time.perf_counter() - t0)
+        return rate, north_star_report(
+            metrics, link_bytes_per_sec=link_bytes_per_sec
+        )
 
-    rate = main()
-    return rate, north_star_report(
-        metrics, link_bytes_per_sec=link_bytes_per_sec
-    )
+    return main()
 
 
 # -- train/MFU bench ----------------------------------------------------------

@@ -298,7 +298,11 @@ class DistributedDataLoader:
                 self.batches_per_window, self.batch_size,
                 *self.shapes[target][1:]
             )
-            dev = self._ingestor.put_window(window)
+            # Byte accounting is deferred to finish(): counting bytes at
+            # completion keeps ingest.bytes and consumer.samples covering
+            # identical windows over any measurement span (dispatch leads
+            # completion by the lookahead depth).
+            dev = self._ingestor.put_window(window, defer_metrics=True)
             held[target] += 1
             cursor = (cursor + 1) % self.n_producers
             return (slot, target, dev, served)
@@ -308,6 +312,8 @@ class DistributedDataLoader:
             # The slot stays ours until the bytes are on device; only then
             # may the producer overwrite it.
             jax.block_until_ready(dev)
+            self.metrics.incr("ingest.bytes", float(dev.nbytes))
+            self.metrics.incr("ingest.windows")
             self.metrics.incr("consumer.windows")
             self.metrics.incr("consumer.samples", served)
             self.connection.rings[target].release(slot)
@@ -336,6 +342,16 @@ class DistributedDataLoader:
                 and held[cursor]
                 < self.connection.rings[cursor].nslots
             ):
+                # Cheap counter peek first: a not-yet-committed window
+                # must not register a wait event in the stall accounting
+                # (it is lookahead, not a stall).  Rings without the peek
+                # (a custom WindowRing not subclassing the base) skip
+                # straight to the timed try.
+                peek = getattr(
+                    self.connection.rings[cursor], "poll_drain_ready", None
+                )
+                if peek is not None and not peek(held[cursor]):
+                    break
                 try:
                     pending.append(start_one(0.0))
                 except StallTimeoutError:

@@ -106,7 +106,9 @@ class DeviceIngestor:
             )
         return self._jax.device_put(arr, target)
 
-    def put_window(self, window: np.ndarray) -> Any:
+    def put_window(
+        self, window: np.ndarray, defer_metrics: bool = False
+    ) -> Any:
         """Transfer a whole window WITHOUT a host copy.
 
         The source may be a live ring-slot view: the caller must keep the
@@ -116,6 +118,13 @@ class DeviceIngestor:
         window beats per-batch/per-column puts wherever the link has fixed
         per-transfer cost (measured on the bench attach: an 8 KiB put costs
         0.15 ms against a 1.4 GB/s link — tools/probe_ingest.py).
+
+        ``defer_metrics=True`` skips the ``ingest.bytes``/``ingest.windows``
+        accounting here so the caller can record it when the transfer
+        *completes* — the window stream does this so bytes-arrived and
+        samples-served counters cover identical windows over any
+        measurement span (a dispatch-time count leads completion by the
+        whole lookahead depth).
         """
         from ddl_tpu.profiling import annotate
 
@@ -128,8 +137,9 @@ class DeviceIngestor:
             window = np.array(window, copy=True)
         with annotate("ddl.ingest_put_window"):
             out = self._transfer(window)
-        self.metrics.incr("ingest.bytes", float(window.nbytes))
-        self.metrics.incr("ingest.windows")
+        if not defer_metrics:
+            self.metrics.incr("ingest.bytes", float(window.nbytes))
+            self.metrics.incr("ingest.windows")
         return out
 
     def _target_platform(self) -> str:
@@ -203,13 +213,12 @@ def north_star_report(
     ``bandwidth_utilization`` — achieved ingest over link capability.
     """
     m = metrics or default_metrics()
-    report = {
-        "samples_per_sec": m.samples_per_sec(),
-        "stall_fraction": m.stall_fraction(),
-        "ingest_bytes_per_sec": m.ingest_bytes_per_sec(),
-        "windows": m.counter("consumer.windows"),
-        "elapsed_s": m.elapsed_s(),
-    }
+    # Metrics.rates() computes every rate over ONE elapsed snapshot, so
+    # bytes/s and samples/s agree exactly when their counters cover
+    # identical windows (they do on the stream path — completion-time
+    # accounting in DistributedDataLoader.windows).
+    report = dict(m.rates())
+    report["windows"] = m.counter("consumer.windows")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

@@ -83,19 +83,47 @@ class Metrics:
 
     # Derived north-star metrics -------------------------------------------
 
+    def rates(self) -> Dict[str, float]:
+        """All derived rates over ONE elapsed snapshot.
+
+        The single formula site: computing each rate with its own "now"
+        (as the per-metric helpers below would if called in sequence)
+        skews their ratios by the microseconds between calls, which is
+        visible on short measurement spans — bytes/s and samples/s must
+        agree exactly when their counters cover identical windows.
+        """
+        with self._lock:
+            # ONE critical section for all three reads: a concurrent
+            # finish() increments bytes then samples, and observing one
+            # without the other would skew the ratio by a window.
+            el = time.perf_counter() - self._t0
+            samples = self._counters.get("consumer.samples", 0.0)
+            nbytes = self._counters.get("ingest.bytes", 0.0)
+            wait = self._timers.get("consumer.wait")
+            stall = wait.total_s if wait else 0.0
+        if el <= 0:
+            return {
+                "samples_per_sec": 0.0,
+                "stall_fraction": 0.0,
+                "ingest_bytes_per_sec": 0.0,
+                "elapsed_s": el,
+            }
+        return {
+            "samples_per_sec": samples / el,
+            "stall_fraction": stall / el,
+            "ingest_bytes_per_sec": nbytes / el,
+            "elapsed_s": el,
+        }
+
     def samples_per_sec(self) -> float:
-        el = self.elapsed_s()
-        return self.counter("consumer.samples") / el if el > 0 else 0.0
+        return self.rates()["samples_per_sec"]
 
     def stall_fraction(self) -> float:
         """Fraction of consumer wall time spent waiting on the pipeline."""
-        el = self.elapsed_s()
-        stall = self.timer("consumer.wait").total_s
-        return stall / el if el > 0 else 0.0
+        return self.rates()["stall_fraction"]
 
     def ingest_bytes_per_sec(self) -> float:
-        el = self.elapsed_s()
-        return self.counter("ingest.bytes") / el if el > 0 else 0.0
+        return self.rates()["ingest_bytes_per_sec"]
 
 
 class _TimedCtx:

@@ -80,6 +80,20 @@ class WindowRing(abc.ABC):
             f"{type(self).__name__} does not support drain lookahead"
         )
 
+    def poll_drain_ready(self, ahead: int = 0) -> bool:
+        """Non-blocking: would :meth:`acquire_drain_ahead` succeed now?
+
+        A cheap counter comparison with no wait machinery or stall
+        accounting — the window-stream lookahead probes with this before
+        acquiring, so a not-yet-committed window costs one read instead
+        of a timed wait event (which would inflate wait-event frequency
+        in stall diagnostics on slow-producer runs).  SPSC makes the
+        answer stable: only the caller (the consumer) can consume the
+        committed slot the peek observed.
+        """
+        s = self.stats()
+        return s["committed"] - s["released"] > ahead
+
     @abc.abstractmethod
     def release(self, slot: int) -> None:
         """Return a drained slot to the producer."""

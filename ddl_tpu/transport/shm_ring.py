@@ -189,6 +189,15 @@ class NativeShmRing(WindowRing):
             "released": float(self._lib.ddlr_stat(self._h, 3)),
         }
 
+    def poll_drain_ready(self, ahead: int = 0) -> bool:
+        # Two counter reads, skipping stats()'s stall-timer FFI calls and
+        # dict build — this runs in the stream's per-window lookahead loop.
+        return (
+            int(self._lib.ddlr_stat(self._h, 2))
+            - int(self._lib.ddlr_stat(self._h, 3))
+            > ahead
+        )
+
     def close(self) -> None:
         # Intentionally does NOT munmap: numpy views created by slot_view
         # hold raw pointers into the mapping, and unmapping under them would

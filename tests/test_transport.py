@@ -111,6 +111,28 @@ class TestRingProtocol:
             ring.acquire_drain(timeout_s=0.05)
         assert ring.stats()["consumer_stall_s"] >= 0.04
 
+    def test_poll_drain_ready_matches_acquire(self, ring):
+        """The non-blocking peek must agree with acquire_drain_ahead's
+        wait predicate at every protocol state — a drifted stats()
+        counter would silently degrade the window stream to zero
+        lookahead (the peek gating dataloader.windows deepening)."""
+        assert not ring.poll_drain_ready(0)
+        s = ring.acquire_fill(timeout_s=5)
+        assert not ring.poll_drain_ready(0)  # filled but not committed
+        ring.commit(s, 4)
+        assert ring.poll_drain_ready(0)
+        assert not ring.poll_drain_ready(1)
+        # Peek-true must imply immediate acquire success.
+        d0 = ring.acquire_drain_ahead(0, timeout_s=0.01)
+        ring.commit(ring.acquire_fill(timeout_s=5), 4)
+        assert ring.poll_drain_ready(1)  # second committed behind held d0
+        d1 = ring.acquire_drain_ahead(1, timeout_s=0.01)
+        assert d1 != d0
+        ring.release(d0)
+        assert ring.poll_drain_ready(0)  # d1 still committed-unreleased
+        ring.release(d1)
+        assert not ring.poll_drain_ready(0)
+
     def test_threaded_stream_integrity(self, ring):
         """Pump 50 windows through concurrently; verify content ordering."""
         n = 50
