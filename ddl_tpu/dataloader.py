@@ -344,13 +344,10 @@ class DistributedDataLoader:
             ):
                 # Cheap counter peek first: a not-yet-committed window
                 # must not register a wait event in the stall accounting
-                # (it is lookahead, not a stall).  Rings without the peek
-                # (a custom WindowRing not subclassing the base) skip
-                # straight to the timed try.
-                peek = getattr(
-                    self.connection.rings[cursor], "poll_drain_ready", None
-                )
-                if peek is not None and not peek(held[cursor]):
+                # (it is lookahead, not a stall).
+                if not self.connection.rings[cursor].poll_drain_ready(
+                    held[cursor]
+                ):
                     break
                 try:
                     pending.append(start_one(0.0))

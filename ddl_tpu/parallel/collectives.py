@@ -196,4 +196,9 @@ class DeviceGlobalShuffler:
             )
             return jax.device_put(back, win_sh) if win_sh else back
 
+        # The hook carries its owner so Trainer.fit can checkpoint the
+        # round counter whichever form the caller passes — the shuffler
+        # itself or this adapter (previously the adapter shape silently
+        # lost round state across resume, replaying round-0 permutations).
+        hook.owner = self
         return hook

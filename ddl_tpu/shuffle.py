@@ -31,6 +31,7 @@ string (Q8) — both strategies here are real, dispatched, and tested.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 import uuid
@@ -148,10 +149,76 @@ class Rendezvous:
 _default_rendezvous = Rendezvous()
 
 
+#: Minimum age before a crashed run's rendezvous dir is fair game for
+#: the sweep below.  Age alone is NOT sufficient to sweep — see the
+#: pid-liveness check in :func:`_sweep_stale_sessions`.
+STALE_SESSION_S = 3600.0
+
+#: Directory-name prefix for every ShmRendezvous session dir — shared by
+#: the minting side (:attr:`ShmRendezvous._dir`) and the sweep's matcher
+#: so a rename cannot silently turn the sweep into a no-op.
+_RDV_PREFIX = "ddl-rdv-"
+
+#: Session names minted by :func:`make_session`: ``{prefix}-{pid}-{hex12}``.
+#: The embedded pid is the sweep's liveness signal.
+_SESSION_RE = re.compile(
+    rf"^{re.escape(_RDV_PREFIX)}.+-(\d+)-[0-9a-f]{{12}}$"
+)
+
+
+def _sweep_stale_sessions(root: str) -> None:
+    """Best-effort removal of abandoned ``ddl-rdv-*`` session dirs.
+
+    /dev/shm is RAM-backed: a crashed or killed run whose ``cleanup()``
+    never ran would otherwise leak its mailboxes until reboot,
+    accumulating on long-lived hosts (ADVICE r4).  A dir is swept only
+    when ALL of:
+
+    - its name matches :func:`make_session`'s shape (hand-named sessions
+      are the caller's to clean — we cannot infer their liveness);
+    - the minting process is DEAD (``kill(pid, 0)`` → ESRCH).  Mtime
+      alone would misfire on a healthy run whose exchange cadence is
+      slower than the age cutoff, and producers of a live run are
+      children of the minting process, so a dead minter means a dead
+      run (pid reuse only ever delays the sweep — conservative);
+    - it is older than :data:`STALE_SESSION_S`, so a session whose
+      minter handed off and exited immediately is still grace-perioded.
+
+    Runs once per (process, root) from the first mailbox creation.
+    """
+    import shutil
+
+    cutoff = time.time() - STALE_SESSION_S
+    try:
+        entries = list(os.scandir(root))
+    except OSError:
+        return
+    for ent in entries:
+        m = _SESSION_RE.match(ent.name)
+        if not m:
+            continue
+        try:
+            if not ent.is_dir(follow_symlinks=False):
+                continue
+            if ent.stat(follow_symlinks=False).st_mtime >= cutoff:
+                continue
+            os.kill(int(m.group(1)), 0)  # raises if the minter is gone
+        except ProcessLookupError:
+            shutil.rmtree(ent.path, ignore_errors=True)
+        except OSError:
+            continue
+
+
+#: Roots already swept by this process (sweep once per process+root).
+_swept_roots: set = set()
+_sweep_lock = threading.Lock()
+
+
 def make_session(prefix: str = "ddl") -> str:
     """A rendezvous session name unique enough to survive crashed prior
     runs (stale mailbox files from an old run with the same session would
-    be popped as this run's round 0)."""
+    be popped as this run's round 0).  The embedded pid doubles as the
+    liveness signal for :func:`_sweep_stale_sessions`."""
     return f"{prefix}-{os.getpid()}-{uuid.uuid4().hex[:12]}"
 
 
@@ -190,7 +257,7 @@ class ShmRendezvous:
 
     @property
     def _dir(self) -> str:
-        return os.path.join(self.root, f"ddl-rdv-{self.session}")
+        return os.path.join(self.root, f"{_RDV_PREFIX}{self.session}")
 
     def _path(self, key: Tuple[int, int, int]) -> str:
         return os.path.join(
@@ -198,6 +265,14 @@ class ShmRendezvous:
         )
 
     def put(self, key: Tuple[int, int, int], rows: np.ndarray) -> None:
+        # First mailbox creation in this process for this root also
+        # reclaims sessions abandoned by crashed prior runs — hung off
+        # the rendezvous (which knows its root) so non-default roots are
+        # swept too, not just /dev/shm.
+        with _sweep_lock:
+            if self.root not in _swept_roots:
+                _swept_roots.add(self.root)
+                _sweep_stale_sessions(self.root)
         os.makedirs(self._dir, exist_ok=True)
         path = self._path(key)
         tmp = f"{path}.tmp.{os.getpid()}"

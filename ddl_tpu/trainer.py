@@ -404,12 +404,18 @@ class Trainer:
             raise ValueError("window_hook requires window_stream=True")
         # A stateful hook provider (DeviceGlobalShuffler or anything with
         # a .window_hook() factory) is passed WHOLE so the trainer can
-        # checkpoint/restore its round state with the loader clock —
-        # a bare callable hook is the caller's responsibility to resume.
+        # checkpoint/restore its round state with the loader clock.  A
+        # bare hook produced by .window_hook() carries its provider as
+        # ``.owner`` — both forms checkpoint identically; only a hand-
+        # written callable with no owner is the caller's responsibility
+        # to resume.
         hook_state = None
-        if window_hook is not None and hasattr(window_hook, "window_hook"):
-            hook_state = window_hook
-            window_hook = hook_state.window_hook()
+        if window_hook is not None:
+            if hasattr(window_hook, "window_hook"):
+                hook_state = window_hook
+                window_hook = hook_state.window_hook()
+            else:
+                hook_state = getattr(window_hook, "owner", None)
         global_shuffle_fraction_exchange = (
             global_shuffle_fraction_exchange or 0.0
         )

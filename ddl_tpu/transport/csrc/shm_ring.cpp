@@ -27,13 +27,16 @@
 #include <cstring>
 
 #include <fcntl.h>
-#include <linux/futex.h>
 #include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
-#include <sys/syscall.h>
 #include <time.h>
 #include <unistd.h>
+
+#ifdef __linux__
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#endif
 
 namespace {
 
@@ -87,6 +90,7 @@ inline uint64_t now_us() {
 // each wake preempting the consumer on 1-core hosts).  NOT
 // FUTEX_PRIVATE: waiter and waker are different processes sharing the
 // mapping.
+#ifdef __linux__
 inline void futex_wait_on(std::atomic<uint32_t>* word, uint32_t expect,
                           int64_t timeout_us) {
   struct timespec ts;
@@ -100,6 +104,20 @@ inline void futex_wake_all(std::atomic<uint32_t>* word) {
   syscall(SYS_futex, reinterpret_cast<uint32_t*>(word), FUTEX_WAKE,
           INT32_MAX, nullptr, nullptr, 0);
 }
+#else
+// Non-Linux POSIX: no cross-process futex — fall back to the bounded
+// usleep ladder (1ms cap, the pre-doorbell behavior).  The caller's
+// loop re-checks the predicate after every nap, so correctness is
+// unchanged; only idle-wakeup cost regresses to ~1000/s.
+inline void futex_wait_on(std::atomic<uint32_t>* word, uint32_t expect,
+                          int64_t timeout_us) {
+  if (word->load(std::memory_order_acquire) != expect) return;
+  if (timeout_us > 1000 || timeout_us < 0) timeout_us = 1000;
+  usleep(static_cast<useconds_t>(timeout_us));
+}
+
+inline void futex_wake_all(std::atomic<uint32_t>*) {}
+#endif
 
 }  // namespace
 

@@ -87,13 +87,32 @@ def _load_native() -> ctypes.CDLL:
     return lib
 
 
+_build_failure_logged = False
+
+
 def native_available() -> bool:
     if os.environ.get("DDL_TPU_FORCE_PY_RING") == "1":
         return False
     try:
         _load_native()
         return True
-    except Exception:
+    except Exception as e:
+        # Degrading to PyShmRing must be VISIBLE: the fallback refuses
+        # non-TSO ISAs and polls instead of event-waiting, so a silently
+        # failing g++ build would change both perf and platform support.
+        global _build_failure_logged
+        if not _build_failure_logged:
+            _build_failure_logged = True
+            import logging
+
+            detail = e.stderr.decode(errors="replace")[:500] if isinstance(
+                e, subprocess.CalledProcessError
+            ) and e.stderr else str(e)
+            logging.getLogger("ddl_tpu").warning(
+                "native shm ring build failed (%s: %s) — falling back to "
+                "the pure-Python ring (TSO ISAs only, polling waits)",
+                type(e).__name__, detail,
+            )
         return False
 
 

@@ -169,6 +169,52 @@ class TestShmRendezvous:
         assert time.monotonic() - t0 < 5.0
         rdv.cleanup()
 
+    def test_stale_session_sweep(self, tmp_path):
+        """A crashed run's RAM-backed mailbox dir is reclaimed once its
+        minting pid is dead AND it is old; a live run's dir survives any
+        age (mtime alone would misfire on slow exchange cadences), as do
+        hand-named sessions and foreign files (ADVICE r4: nothing else
+        ever removed an uncleaned session)."""
+        import os
+        import uuid
+
+        import ddl_tpu.shuffle as shuffle_mod
+        from ddl_tpu.shuffle import ShmRendezvous
+
+        # A pid that cannot be alive: spawn a trivial child and reap it
+        # (no os.fork — forking the multi-threaded pytest/JAX process
+        # can deadlock the child).
+        import subprocess
+        import sys
+
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        dead_pid = child.pid
+
+        def session(pid):
+            return f"t-{pid}-{uuid.uuid4().hex[:12]}"
+
+        crashed = ShmRendezvous(session(dead_pid), root=str(tmp_path))
+        crashed.put((0, 0, 0), np.zeros(2, np.float32))
+        live_old = ShmRendezvous(session(os.getpid()), root=str(tmp_path))
+        live_old.put((0, 0, 0), np.zeros(2, np.float32))
+        young = ShmRendezvous(session(dead_pid), root=str(tmp_path))
+        young.put((0, 0, 0), np.zeros(2, np.float32))
+        named = ShmRendezvous("hand-named-old", root=str(tmp_path))
+        named.put((0, 0, 0), np.zeros(2, np.float32))
+        other = tmp_path / "ddl-rdv-not-a-dir"
+        other.write_text("plain file, never touched")
+        old = time.time() - 2 * shuffle_mod.STALE_SESSION_S
+        for rdv in (crashed, live_old, named):
+            os.utime(rdv._dir, (old, old))
+
+        shuffle_mod._sweep_stale_sessions(str(tmp_path))
+        assert not os.path.isdir(crashed._dir)  # dead minter + old: swept
+        assert os.path.isdir(live_old._dir)  # alive minter: kept at any age
+        assert os.path.isdir(young._dir)  # dead minter but young: grace
+        assert os.path.isdir(named._dir)  # hand-named: caller's to clean
+        assert other.read_text() == "plain file, never touched"
+
     def test_factory_is_picklable(self, tmp_path):
         """PROCESS mode ships the factory by pickle to spawned workers —
         a closure factory (the pre-fix shape) would fail right here."""

@@ -122,6 +122,33 @@ def test_fit_window_hook_shuffler_round_resumes(rng, tmp_path):
     assert sh2._round == 4, sh2._round
 
 
+def test_fit_window_hook_adapter_round_resumes(rng, tmp_path):
+    """The ADAPTER form (`window_hook=sh.window_hook()`) checkpoints the
+    round exactly like passing the shuffler whole: the hook carries its
+    owner, so the easy-misuse shape no longer silently replays round-0
+    permutations after resume (ADVICE r4)."""
+    from ddl_tpu.parallel import DeviceGlobalShuffler
+
+    seed = int(rng.integers(1 << 30))
+    _, t1 = _make_trainer(tmp_path)
+    sh1 = DeviceGlobalShuffler(t1.mesh, num_exchange=2, seed=3)
+    t1.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=2,
+        n_producers=2, mode="thread", output="jax", window_stream=True,
+        window_hook=sh1.window_hook(),
+    )
+    assert sh1._round == 2
+    _, t2 = _make_trainer(tmp_path)
+    sh2 = DeviceGlobalShuffler(t2.mesh, num_exchange=2, seed=3)
+    r2 = t2.fit(
+        _producer(np.random.default_rng(seed)), batch_size=16, n_epochs=4,
+        n_producers=2, mode="thread", output="jax", window_stream=True,
+        window_hook=sh2.window_hook(),
+    )
+    assert r2.resumed_from_epoch == 2
+    assert sh2._round == 4, sh2._round
+
+
 def test_fit_window_hook_device_shuffler(rng):
     """THE documented composition (docs/API.md): DeviceGlobalShuffler's
     window_hook() adapter through the streamed Trainer — one exchange
