@@ -176,25 +176,15 @@ def forward(
     within each packed document (kernel-level masking; RoPE positions
     remain row-global, the common packed-training convention).
     """
-    from ddl_tpu.parallel.ring_attention import attention
-
     B, T = tokens.shape
     dt = cfg.dtype
     positions = jnp.arange(T)
     x = params["embed"].astype(dt)[tokens]  # (B, T, D)
 
     def layer_fn(x: jax.Array, layer: Params) -> jax.Array:
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q, k, v = _attn_qkv(layer, h, cfg, positions)
-        # GQA k/v stay compact: expansion happens inside the attention
-        # block, so ring attention rotates 1/rep of the bytes over ICI.
-        rep = cfg.n_heads // cfg.n_kv_heads
-        attn = attention(
-            q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
-            kv_repeat=rep, segment_ids=segment_ids,
+        return _layer_apply(
+            layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
         )
-        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
-        return _mlp_block(layer, x, cfg)
 
     if cfg.remat:
         # Save only each layer's residual-stream input; recompute the
@@ -206,6 +196,34 @@ def forward(
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def _layer_apply(
+    layer: Params,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """One transformer block on the residual stream — the single layer
+    body shared by :func:`forward` and the pipeline-parallel
+    :func:`forward_pp` (same math, so pp/non-pp cannot diverge)."""
+    from ddl_tpu.parallel.ring_attention import attention
+
+    B, T = x.shape[:2]
+    dt = x.dtype
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q, k, v = _attn_qkv(layer, h, cfg, positions)
+    # GQA k/v stay compact: expansion happens inside the attention
+    # block, so ring attention rotates 1/rep of the bytes over ICI.
+    rep = cfg.n_heads // cfg.n_kv_heads
+    attn = attention(
+        q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
+        kv_repeat=rep, segment_ids=segment_ids,
+    )
+    x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+    return _mlp_block(layer, x, cfg)
 
 
 def _attn_qkv(layer: Params, h: jax.Array, cfg: LlamaConfig,
@@ -263,38 +281,19 @@ def forward_with_cache(
     bandwidth-bound decode hot path).  The cache length is static
     (``init_cache`` max_len) for jit-stable shapes.
     """
-    B, T = tokens.shape
     dt = cfg.dtype
-    L = cache["k"].shape[2]
-    positions = pos + jnp.arange(T)
-    cache_idx = jnp.arange(L)
+    positions = pos + jnp.arange(tokens.shape[1])
+    cache_idx = jnp.arange(cache["k"].shape[2])
     x = params["embed"].astype(dt)[tokens]
-    scale = 1.0 / (cfg.head_dim**0.5)
-    rep = cfg.n_heads // cfg.n_kv_heads
 
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q, k, v = _attn_qkv(layer, h, cfg, positions)
-        ck = jax.lax.dynamic_update_slice(
-            cache["k"][li], k.astype(dt), (0, pos, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cache["v"][li], v.astype(dt), (0, pos, 0, 0)
+        x, ck, cv = _attn_with_cache(
+            layer, x, cfg, cache["k"][li], cache["v"][li], pos,
+            positions, cache_idx,
         )
         new_k.append(ck)
         new_v.append(cv)
-        # Grouped-query attention against the compact cache: q regrouped
-        # per KV head, scores (B, Hkv, rep, T, L).
-        qg = q.reshape(B, T, cfg.n_kv_heads, rep, cfg.head_dim)
-        s = jnp.einsum("bqkrd,bskd->bkrqs", qg, ck) * scale
-        # Causal over absolute positions; cache slots past the frontier
-        # (zeros) are masked the same way.
-        mask = cache_idx[None, :] > positions[:, None]  # (T, L)
-        s = jnp.where(mask[None, None, None], -1e30, s)
-        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
-        attn = jnp.einsum("bkrqs,bskd->bqkrd", p, cv)
-        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
         x = _mlp_block(layer, x, cfg)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -302,6 +301,44 @@ def forward_with_cache(
         x = x[:, -1:]
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def _attn_with_cache(
+    layer: Params,
+    x: jax.Array,
+    cfg: Any,
+    k_buf: jax.Array,
+    v_buf: jax.Array,
+    pos: jax.Array,
+    positions: jax.Array,
+    cache_idx: jax.Array,
+):
+    """Attention sub-block (norm → qkv → cache update → GQA attention →
+    residual) against a static-length KV cache — shared by llama and moe
+    decode (same cache math, different MLP sub-block).  Returns
+    (x_after_attn, new_k_buf, new_v_buf).
+
+    Grouped-query attention attends the COMPACT cache via a grouped
+    einsum (q regrouped per KV head, scores (B, Hkv, rep, T, L)) — no
+    rep-expanded cache copy in the bandwidth-bound decode hot path.
+    """
+    B, T = x.shape[:2]
+    dt = x.dtype
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (cfg.head_dim**0.5)
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q, k, v = _attn_qkv(layer, h, cfg, positions)
+    ck = jax.lax.dynamic_update_slice(k_buf, k.astype(dt), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(v_buf, v.astype(dt), (0, pos, 0, 0))
+    qg = q.reshape(B, T, cfg.n_kv_heads, rep, cfg.head_dim)
+    s = jnp.einsum("bqkrd,bskd->bkrqs", qg, ck) * scale
+    # Causal over absolute positions; cache slots past the frontier
+    # (zeros) are masked the same way.
+    mask = cache_idx[None, :] > positions[:, None]  # (T, L)
+    s = jnp.where(mask[None, None, None], -1e30, s)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
+    attn = jnp.einsum("bkrqs,bskd->bqkrd", p, cv)
+    return x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt), ck, cv
 
 
 def generate(
@@ -323,12 +360,33 @@ def generate(
     matmuls on the MXU); decode steps run under ``lax.scan`` with a
     static-shape KV cache — no recompilation per step, no Python loop.
     """
+    return _generate(
+        forward_with_cache, init_cache, params, prompt, cfg,
+        max_new_tokens, temperature, key,
+    )
+
+
+def _generate(
+    fwd_cache: Any,
+    init_cache_fn: Any,
+    params: Params,
+    prompt: jax.Array,
+    cfg: Any,
+    max_new_tokens: int,
+    temperature: float,
+    key: Optional[jax.Array],
+) -> jax.Array:
+    """Family-agnostic generation core (llama and moe share it): prefill
+    via one cached forward, then ``lax.scan`` decode steps over a
+    static-shape cache.  ``fwd_cache(params, tokens, cfg, cache, pos,
+    last_only=...) -> (logits, cache)`` and ``init_cache_fn(cfg, B, L)``
+    are the family's decode hooks."""
     B, P_len = prompt.shape
     if max_new_tokens <= 0:
         return prompt
     total = P_len + max_new_tokens
-    cache = init_cache(cfg, B, total)
-    logits, cache = forward_with_cache(
+    cache = init_cache_fn(cfg, B, total)
+    logits, cache = fwd_cache(
         params, prompt, cfg, cache, jnp.int32(0), last_only=True
     )
     last = logits[:, -1]
@@ -351,7 +409,7 @@ def generate(
     def step(carry, k):
         cache, last_logits, pos = carry
         tok = pick(last_logits, k)
-        logits_t, cache = forward_with_cache(
+        logits_t, cache = fwd_cache(
             params, tok[:, None], cfg, cache, pos
         )
         return (cache, logits_t[:, 0], pos + 1), tok
@@ -390,3 +448,130 @@ def next_token_loss(
 
     logits = forward(params, tokens, cfg, mesh, segment_ids=segment_ids)
     return next_token_cross_entropy(logits, tokens, segment_ids=segment_ids)
+
+
+# -- pipeline parallelism ----------------------------------------------------
+
+
+def stage_params(params: Params, n_stages: int) -> Params:
+    """Rearrange a :func:`init_params` pytree for pipeline parallelism.
+
+    The ``n_layers`` per-layer dicts regroup into ``n_stages`` equal
+    stages and stack into leaves with leading ``(S, L/S)`` axes —
+    :func:`ddl_tpu.parallel.pipeline_apply`'s stacked-stage layout, with
+    the S axis sharded over ``pp`` so each device stores only its own
+    stage's layers.  Embedding, final norm and lm head stay outside the
+    pipe (they run replicated over pp, before/after the schedule).
+
+    Inverse-free by design: training checkpoints save THIS layout; the
+    non-pp layout is only an initialization convenience.
+    """
+    L = len(params["layers"])
+    if n_stages < 1 or L % n_stages:
+        raise ValueError(
+            f"n_layers={L} must divide into n_stages={n_stages}"
+        )
+    per = L // n_stages
+    stages = [
+        jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *params["layers"][s * per : (s + 1) * per],
+        )
+        for s in range(n_stages)
+    ]
+    return {
+        "embed": params["embed"],
+        "stages": jax.tree.map(lambda *xs: jnp.stack(xs), *stages),
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def pp_param_specs(cfg: LlamaConfig, axis: str = "pp") -> Params:
+    """PartitionSpecs for the :func:`stage_params` layout: ``pp`` shards
+    the stage axis (at-rest storage is one stage per pp group), the
+    per-stage layer axis is unsharded, and the trailing axes keep the
+    Megatron fsdp/tp layout of :func:`param_specs`."""
+    layer = param_specs(cfg)["layers"][0]
+    return {
+        "embed": P(None, "fsdp"),
+        "stages": jax.tree.map(
+            lambda s: P(axis, None, *tuple(s)),
+            layer,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def forward_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Next-token logits with the transformer blocks pipelined over the
+    mesh's ``axis`` (GPipe microbatch schedule,
+    :func:`ddl_tpu.parallel.pipeline_apply`).
+
+    ``params`` is the :func:`stage_params` layout.  Each pipeline stage
+    scans its ``L/S`` layers over the residual stream; attention inside a
+    stage is single-device (dense or flash) — sequence parallelism does
+    not compose with pp in this schedule (``segment_ids`` likewise
+    unsupported here; use :func:`forward` for packed batches).
+
+    Working-memory model (the honest cost account): each device holds
+    its OWN stage's weights in full for the whole step — fsdp/tp shard
+    the at-rest storage, but ``pipeline_apply`` gathers the trailing
+    axes at the shard_map boundary, so peak per-device weight memory is
+    ``params/S`` regardless of fsdp — plus one microbatch's activations
+    times the live scan depth.  At 8B/S=4 that is ~4 GiB bf16 weights
+    resident per device; pp is the axis that divides weight working
+    memory, fsdp divides only storage.
+    """
+    B, T = tokens.shape
+    dt = cfg.dtype
+    positions = jnp.arange(T)
+    x = params["embed"].astype(dt)[tokens]
+
+    def one_layer(x: jax.Array, layer: Params) -> jax.Array:
+        return _layer_apply(layer, x, cfg, positions, mesh=None)
+
+    layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
+
+    def stage_fn(stage: Params, h: jax.Array) -> jax.Array:
+        out, _ = jax.lax.scan(
+            lambda c, lyr: (layer_fn(c, lyr), None), h, stage
+        )
+        return out
+
+    from ddl_tpu.parallel.pipeline import pipeline_apply
+
+    x = pipeline_apply(
+        params["stages"], x, stage_fn, mesh, n_microbatches, axis=axis
+    )
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def next_token_loss_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """:func:`next_token_loss` over the pipelined forward — the loss to
+    hand :func:`ddl_tpu.parallel.train.make_train_step` (or the Trainer)
+    for a pp-axis mesh; backward runs the reverse schedule through
+    ``jax.grad`` automatically."""
+    from ddl_tpu.models.losses import next_token_cross_entropy
+
+    logits = forward_pp(
+        params, tokens, cfg, mesh, n_microbatches, axis=axis
+    )
+    return next_token_cross_entropy(logits, tokens)

@@ -8,6 +8,7 @@ mesh axes; collectives are XLA ops inserted by ``shard_map``/``pjit``.
 from ddl_tpu.parallel.collectives import DeviceGlobalShuffler
 from ddl_tpu.parallel.mesh import data_parallel_mesh, make_mesh
 from ddl_tpu.parallel.pipeline import (
+    bubble_fraction,
     pipeline_apply,
     pipeline_spec,
     stack_stage_params,
@@ -15,6 +16,7 @@ from ddl_tpu.parallel.pipeline import (
 
 __all__ = [
     "DeviceGlobalShuffler",
+    "bubble_fraction",
     "data_parallel_mesh",
     "make_mesh",
     "pipeline_apply",

@@ -26,8 +26,13 @@ Stage parameters are user-stacked with a leading S axis sharded
 (plus any fsdp/tp sharding of the trailing axes).  Inside the pipeline's
 ``shard_map`` each device needs its stage's weights IN FULL (``stage_fn``
 is a plain local function), so trailing-axis shards are gathered at the
-shard_map boundary each step — pp composes with fsdp/tp for storage, not
-for per-step working memory.
+shard_map boundary each step.  The working-memory model, explicitly:
+peak per-device weight bytes = params/S (own stage, full) + one
+microbatch's activations — pp divides weight WORKING memory by S;
+fsdp/tp on the trailing axes divide at-rest STORAGE only.  The gather
+moves each device's own stage once per step over ICI (params/S bytes),
+amortised across all S+M-1 ticks; it is not a per-tick cost.
+:func:`ddl_tpu.models.llama.forward_pp` documents the 8B-scale numbers.
 """
 
 from __future__ import annotations
@@ -39,6 +44,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """GPipe idle fraction: of the ``S + M - 1`` schedule ticks each
+    stage sees, ``S - 1`` are fill/drain bubble — the ideal against
+    which measured pipeline efficiency is judged (``tools/probe_pp.py``
+    measures the actual ratio; the ``lax.cond`` in the tick body makes
+    bubble ticks cost a branch instead of a layer, so measured should
+    approach this analytic floor from above)."""
+    if n_stages < 1 or n_microbatches < 1:
+        raise ValueError((n_stages, n_microbatches))
+    return (n_stages - 1) / (n_stages + n_microbatches - 1)
 
 
 def stack_stage_params(per_stage: list) -> Any:

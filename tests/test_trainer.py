@@ -167,6 +167,57 @@ def test_fit_window_hook_device_shuffler(rng):
     assert all(np.isfinite(l) for l in res.losses)
 
 
+def test_fit_pipeline_parallel_llama(rng):
+    """Trainer integration for pipeline parallelism (VERDICT r4 item 4):
+    the pipelined llama loss + pp param specs drop into Trainer.fit's
+    window-streamed path on a pp=4 × dp=2 mesh — producers feed token
+    windows, each window trains through the GPipe schedule."""
+    from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+    from ddl_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab=64, d_model=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=64, dtype=jax.numpy.float32, attn_impl="dense",
+    )
+    mesh = make_mesh({"pp": 4, "dp": 2})
+
+    class TokenWindows(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            self._rng = np.random.default_rng(producer_idx)
+            return DataProducerOnInitReturn(
+                nData=16, nValues=16, shape=(16, 16), splits=(16,),
+                dtype=np.int32,
+            )
+
+        def post_init(self, my_ary, **kw):
+            my_ary[:] = self._rng.integers(0, cfg.vocab, my_ary.shape)
+
+        def execute_function(self, my_ary, **kw):
+            my_ary[:] = self._rng.integers(0, cfg.vocab, my_ary.shape)
+
+    trainer = Trainer(
+        loss_fn=lambda p, b: llama.next_token_loss_pp(
+            p, b[0], cfg, mesh, n_microbatches=4
+        ),
+        optimizer=optax.adamw(1e-2),
+        mesh=mesh,
+        param_specs=llama.pp_param_specs(cfg),
+        init_params=llama.stage_params(
+            llama.init_params(cfg, jax.random.key(0)), 4
+        ),
+        batch_spec=P(("dp",)),
+        watchdog=False,
+    )
+    res = trainer.fit(
+        TokenWindows(), batch_size=8, n_epochs=3, n_producers=2,
+        mode="thread", output="jax", window_stream=True,
+    )
+    assert len(res.losses) == 3
+    assert all(np.isfinite(l) for l in res.losses), res.losses
+    assert abs(res.losses[0] - np.log(cfg.vocab)) < 1.0  # real LM loss
+    assert res.losses[-1] < res.losses[0]  # it learns through the pipe
+
+
 def test_fit_jax_output(rng):
     """output='jax': batches land on device via the ingest path."""
     _, trainer = _make_trainer()
