@@ -238,6 +238,87 @@ def forward(
     return logits, aux_total / cfg.n_layers
 
 
+# -- inference: KV-cache decode + generate -----------------------------------
+
+
+def init_cache(cfg: MoeConfig, batch: int, max_len: int) -> Params:
+    """Per-layer KV cache buffers for autoregressive decoding — THE
+    llama cache layout (one delegation, so the layout backing the shared
+    ``_attn_with_cache`` math cannot drift between families); the routed
+    MLP needs no cache of its own, routing re-decides per decoded
+    token."""
+    return _llama.init_cache(cfg, batch, max_len)
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    cache: Params,
+    pos: jax.Array,
+    last_only: bool = False,
+) -> Tuple[jax.Array, Params]:
+    """Cached MoE forward (prefill: T = prompt length; decode: T = 1).
+
+    The attention sub-block is the shared cache math
+    (``llama._attn_with_cache``: compact GQA cache, causal-position
+    mask); each decoded token then routes through the SAME top-k gate as
+    training (``moe_mlp`` on the flat (B*T, D) tokens).
+
+    Capacity semantics: expert capacity is computed from the call's OWN
+    token count.  Prefill routes the whole prompt jointly — identical
+    N to the training forward, so prefill logits match it exactly, drops
+    included.  Stepwise decode routes B tokens per step with fresh
+    capacity, so it matches the full forward exactly whenever capacity
+    does not bind (routing is per-token; slot assignment only matters
+    when a token is dropped) — under capacity pressure the decode path
+    DROPS LESS than teacher forcing, never more.  Returns (logits,
+    updated cache); router aux loss is a training quantity and is not
+    computed here.
+    """
+    B, T = tokens.shape
+    dt = cfg.dtype
+    positions = pos + jnp.arange(T)
+    cache_idx = jnp.arange(cache["k"].shape[2])
+    x = params["embed"].astype(dt)[tokens]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        x, ck, cv = _llama._attn_with_cache(
+            layer, x, cfg, cache["k"][li], cache["v"][li], pos,
+            positions, cache_idx,
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+        h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        moe_out, _aux = moe_mlp(h.reshape(B * T, -1), layer, cfg)
+        x = x + moe_out.reshape(B, T, -1)
+
+    x = _llama._rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:]
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: MoeConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive MoE generation — same contract as
+    ``models.llama.generate`` (greedy or explicit-key sampling; prefill
+    in one cached forward, scanned decode steps), completing inference
+    parity across the model families."""
+    return _llama._generate(
+        forward_with_cache, init_cache, params, prompt, cfg,
+        max_new_tokens, temperature, key,
+    )
+
+
 def next_token_loss(
     params: Params,
     tokens: jax.Array,

@@ -133,6 +133,70 @@ class TestMoeModel:
         loss = moe.next_token_loss(params, t1, cfg, segment_ids=seg)
         assert np.isfinite(float(loss))
 
+    def test_cached_prefill_matches_forward(self, rng):
+        """forward_with_cache over a whole prompt == plain forward —
+        EXACTLY, drops included: prefill routes the same token set with
+        the same capacity as the training forward."""
+        cfg = _cfg(n_layers=2)
+        params = moe.init_params(cfg, jax.random.key(0))
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (2, 12)), jnp.int32)
+        full, _aux = moe.forward(params, tokens, cfg)
+        cache = moe.init_cache(cfg, 2, 12)
+        cached, _ = moe.forward_with_cache(
+            params, tokens, cfg, cache, jnp.int32(0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(full), np.asarray(cached), rtol=2e-5, atol=2e-5
+        )
+
+    def test_stepwise_decode_matches_teacher_forcing(self, rng):
+        """One-token cached steps reproduce the full forward's logits at
+        every position.  Ample capacity (see forward_with_cache's
+        capacity-semantics note): routing is per-token, so with no drops
+        in either path the KV-cache decode is exact."""
+        cfg = _cfg(n_layers=2, capacity_factor=8.0)
+        params = moe.init_params(cfg, jax.random.key(0))
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (1, 10)), jnp.int32)
+        full, _aux = moe.forward(params, tokens, cfg)
+        cache = moe.init_cache(cfg, 1, 10)
+        for t in range(10):
+            lt, cache = moe.forward_with_cache(
+                params, tokens[:, t : t + 1], cfg, cache, jnp.int32(t)
+            )
+            np.testing.assert_allclose(
+                np.asarray(full[:, t]), np.asarray(lt[:, 0]),
+                rtol=2e-5, atol=2e-5, err_msg=f"position {t}",
+            )
+
+    def test_greedy_generate(self, rng):
+        """Greedy MoE generation: deterministic, prompt-prefixed, first
+        emitted token teacher-force-checked — llama's generate contract
+        on the MoE family."""
+        cfg = _cfg(n_layers=2, capacity_factor=8.0)
+        params = moe.init_params(cfg, jax.random.key(0))
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 5)), jnp.int32)
+        out = moe.generate(params, prompt, cfg, max_new_tokens=4)
+        assert out.shape == (2, 9)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, :5]), np.asarray(prompt)
+        )
+        out2 = moe.generate(params, prompt, cfg, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+        full, _aux = moe.forward(params, prompt, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 5]),
+            np.asarray(jnp.argmax(full[:, -1], axis=-1)),
+        )
+
+    def test_sampled_generate_requires_key(self, rng):
+        cfg = _cfg()
+        params = moe.init_params(cfg, jax.random.key(0))
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab, (1, 4)), jnp.int32)
+        with pytest.raises(ValueError, match="explicit PRNG key"):
+            moe.generate(
+                params, prompt, cfg, max_new_tokens=2, temperature=0.7
+            )
+
     def test_loss_decreases_on_ep_mesh(self):
         cfg = _cfg()
         mesh = make_mesh({"dp": 2, "ep": 4})
