@@ -1,4 +1,4 @@
-"""Two-process ``jax.distributed`` integration program (MULTIHOST mode).
+"""Multi-process ``jax.distributed`` integration program (MULTIHOST mode).
 
 The honest translation of the reference's only executable spec — its
 ``mpirun -np 4`` end-to-end run (reference ``tests/test_ddl.py:14``) — to
@@ -9,6 +9,19 @@ into global dp-sharded arrays via the ``process_count > 1`` branch of
 global mesh, and a device-side global shuffle exchanges window lanes
 across hosts.  Driven by ``tests/test_multihost.py``.
 
+Parameterized by env (inherited by spawned producer workers, so module
+constants stay consistent across the pickle boundary):
+
+- ``DDL_MH_PROCS`` (default 2): number of "host" processes — the np=4
+  analog runs with 4.
+- ``DDL_MH_DEVS`` (default 2): virtual devices per host.
+- ``DDL_MH_LEGS`` (default "core,stream,packed"): comma list of legs —
+  ``core`` (coverage + GSPMD step + device shuffle), ``stream``
+  (zero-copy global window stream), ``packed`` (packed-segment stream
+  fit), ``dpsp`` (loader feeding a dp×sp global mesh, ring attention
+  over sp), ``ckpt`` (checkpoint → fresh-state restore → loader
+  fast-forward resume on a shared dir, ``DDL_MH_DIR``).
+
 Usage: python multihost_prog.py <process_id> <coordinator_address>
 """
 
@@ -17,8 +30,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-N_PROCESSES = 2
-DEVICES_PER_PROCESS = 2
+N_PROCESSES = int(os.environ.get("DDL_MH_PROCS", "2"))
+DEVICES_PER_PROCESS = int(os.environ.get("DDL_MH_DEVS", "2"))
+LEGS = tuple(
+    os.environ.get("DDL_MH_LEGS", "core,stream,packed").split(",")
+)
 N_PRODUCERS = 2
 N_DATA, N_VALUES = 32, 8
 BATCH = 8
@@ -55,6 +71,26 @@ class TaggedProducer(ProducerFunctionSkeleton):
 
     def execute_function(self, my_ary, **kw):
         pass  # deterministic windows (coverage is the assertion)
+
+
+SP_SEQ = 16
+
+
+class TokenProducer(ProducerFunctionSkeleton):
+    """int32 token rows for the dp×sp leg (module-level: picklable)."""
+
+    def on_init(self, producer_idx=0, **kw):
+        self._rng = np.random.default_rng(producer_idx)
+        return DataProducerOnInitReturn(
+            nData=N_DATA, nValues=SP_SEQ, shape=(N_DATA, SP_SEQ),
+            splits=(SP_SEQ,), dtype=np.int32,
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = self._rng.integers(0, 64, my_ary.shape)
+
+    def execute_function(self, my_ary, **kw):
+        my_ary[:] = self._rng.integers(0, 64, my_ary.shape)
 
 
 def main(process_id: int, coordinator: str) -> None:
@@ -129,10 +165,10 @@ def main(process_id: int, coordinator: str) -> None:
                 loader.mark(Marker.END_OF_BATCH)
             loader.mark(Marker.END_OF_EPOCH)
 
-        # Coverage: every process saw BOTH hosts' producers' data.
+        # Coverage: every process saw EVERY host's producers' data.
         instances = {t // 1000 for t in seen_tags}
         producers = {(t // 1000, (t % 1000) // 100) for t in seen_tags}
-        assert instances == {0, 1}, instances
+        assert instances == set(range(N_PROCESSES)), instances
         assert len(producers) == N_PROCESSES * N_PRODUCERS, producers
 
         # Device-side global shuffle across hosts: lanes move between
@@ -153,7 +189,8 @@ def main(process_id: int, coordinator: str) -> None:
         assert not np.array_equal(before, after)
         return float(loss)
 
-    run()
+    if "core" in LEGS:
+        run()
 
     @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
     def run_stream(env):
@@ -182,10 +219,161 @@ def main(process_id: int, coordinator: str) -> None:
                 int(t) for t in np.asarray(gather(win))[..., -1].ravel()
             )
             loader.mark(Marker.END_OF_EPOCH)
-        # Both hosts' windows landed in every global array.
-        assert {t // 1000 for t in tags} == {0, 1}, tags
+        # Every host's windows landed in every global array.
+        assert {t // 1000 for t in tags} == set(range(N_PROCESSES)), tags
 
-    run_stream()
+    if "stream" in LEGS:
+        run_stream()
+
+    # ---- dp×sp global mesh fed by the loader (VERDICT r4 item 6) -------
+    # Sequence parallelism on the GLOBAL mesh: each host's loader
+    # contributes its row block of the global token batch with the seq
+    # axis sharded over its own sp pair (mesh order (dp, sp) puts both
+    # sp coordinates of a dp row on one host, so every process's local
+    # window IS its addressable shard set), the llama loss runs ring
+    # attention over sp, and the dp gradient psum crosses hosts — the
+    # loader and sequence parallelism composing on one global mesh.
+    from ddl_tpu.models import llama as _llama_mod
+
+    if "dpsp" in LEGS:
+        assert DEVICES_PER_PROCESS % 2 == 0, (
+            "dpsp leg needs sp=2 within each host's devices"
+        )
+        spcfg = _llama_mod.LlamaConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq=SP_SEQ, dtype=jax.numpy.float32,
+        )
+
+        @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+        def run_dpsp(env):
+            total = N_PROCESSES * DEVICES_PER_PROCESS
+            mesh = make_mesh({"dp": total // 2, "sp": 2})
+            init_fn, step_fn = make_train_step(
+                lambda p, b: _llama_mod.next_token_loss(
+                    p, b[0], spcfg, mesh=mesh
+                ),
+                optax.sgd(1e-2), mesh, _llama_mod.param_specs(spcfg),
+                batch_spec=P(("dp",), "sp"),
+            )
+            state = init_fn(_llama_mod.init_params(spcfg, jax.random.key(0)))
+            loader = DistributedDataLoader(
+                TokenProducer(), batch_size=BATCH,
+                connection=env.connection, n_epochs=2, output="numpy",
+            )
+            losses = []
+            for _epoch in range(2):
+                for (tok,) in loader:
+                    gtok = make_global_array(
+                        tok, NamedSharding(mesh, P(("dp",), "sp"))
+                    )
+                    assert gtok.shape == (N_PROCESSES * BATCH, SP_SEQ)
+                    state, loss = step_fn(state, (gtok,))
+                    losses.append(float(loss))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            # Uniform-random tokens carry no learnable signal; the
+            # assertion is execution of the full dp×sp step, not
+            # convergence.
+            assert losses and all(np.isfinite(l) for l in losses)
+
+        run_dpsp()
+
+    # ---- checkpoint → restore → resume on a shared dir (item 6) --------
+    # The multihost round trip: every process participates in one Orbax
+    # save of the GLOBAL sharded train state; a FRESH state restores from
+    # the shared dir onto the same mesh; a FRESH loader fast-forwards by
+    # the captured window clock and serves exactly the window the
+    # pre-"restart" run would have seen next.
+    if "ckpt" in LEGS:
+        ckpt_dir = os.environ["DDL_MH_DIR"]
+        from ddl_tpu.checkpoint import (
+            LoaderCheckpoint,
+            restore_train_state,
+            save_train_state,
+        )
+
+        @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+        def run_ckpt_first(env):
+            mesh = make_mesh({"dp": N_PROCESSES * DEVICES_PER_PROCESS})
+            init_fn, step_fn = make_train_step(
+                lambda p, b: (
+                    ((b[0] * 1e-3) @ p["w"]).mean() - (b[1] * 1e-3).mean()
+                ) ** 2,
+                optax.sgd(1e-3), mesh, {"w": P(None)},
+                batch_spec=P(("dp",)),
+            )
+            state = init_fn({"w": np.zeros((N_VALUES - 1,), np.float32)})
+            loader = DistributedDataLoader(
+                TaggedProducer(env.topology.instance_idx),
+                batch_size=BATCH, connection=env.connection, n_epochs=4,
+                output="numpy",
+            )
+            batch_sh = NamedSharding(mesh, P("dp"))
+            for _epoch in range(2):
+                for x, y in loader:
+                    state, _ = step_fn(
+                        state,
+                        (make_global_array(x, batch_sh),
+                         make_global_array(y, batch_sh)),
+                    )
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            # All processes join the sharded save; the loader clock is
+            # host-local state, one JSON per process.
+            save_train_state(state, ckpt_dir)
+            LoaderCheckpoint.capture(loader).save(
+                os.path.join(ckpt_dir, f"loader_{jax.process_index()}.json")
+            )
+            # The next window each target would serve (ground truth for
+            # the resumed run): epoch 2 serves producer windows again in
+            # rotation — record the rotation target.
+            return state.step, loader._target
+
+        step_before, target_before = run_ckpt_first()
+
+        @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+        def run_ckpt_resume(env):
+            mesh = make_mesh({"dp": N_PROCESSES * DEVICES_PER_PROCESS})
+            init_fn, step_fn = make_train_step(
+                lambda p, b: (
+                    ((b[0] * 1e-3) @ p["w"]).mean() - (b[1] * 1e-3).mean()
+                ) ** 2,
+                optax.sgd(1e-3), mesh, {"w": P(None)},
+                batch_spec=P(("dp",)),
+            )
+            fresh = init_fn({"w": np.zeros((N_VALUES - 1,), np.float32)})
+            state = restore_train_state(ckpt_dir, fresh)
+            assert state.step == step_before, (state.step, step_before)
+            ck = LoaderCheckpoint.load(
+                os.path.join(ckpt_dir, f"loader_{jax.process_index()}.json")
+            )
+            loader = DistributedDataLoader(
+                TaggedProducer(env.topology.instance_idx),
+                batch_size=BATCH, connection=env.connection, n_epochs=4,
+                output="numpy",
+            )
+            # Deterministic producers: skip the windows the first run
+            # consumed; the loader now sits at the captured position.
+            loader.fast_forward(ck.epoch)
+            ck.apply(loader)
+            assert loader._target == target_before
+            assert loader.epoch == 2
+            batch_sh = NamedSharding(mesh, P("dp"))
+            losses = []
+            for _epoch in range(2):
+                for x, y in loader:
+                    state, loss = step_fn(
+                        state,
+                        (make_global_array(x, batch_sh),
+                         make_global_array(y, batch_sh)),
+                    )
+                    losses.append(float(loss))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            assert losses and all(np.isfinite(l) for l in losses)
+            assert state.step == step_before + len(losses)
+
+        run_ckpt_resume()
 
     # ---- Window-stream FIT with PACKED SEGMENTS (VERDICT r3 item 5) ----
     # The round-3 flagship paths under real multi-process jax.distributed
@@ -255,7 +443,8 @@ def main(process_id: int, coordinator: str) -> None:
         # segment mask is live (not vacuously all-zeros).
         assert saw_boundary
 
-    run_packed_stream_fit()
+    if "packed" in LEGS:
+        run_packed_stream_fit()
     print(f"MULTIHOST OK process={process_id}", flush=True)
 
 

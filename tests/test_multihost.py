@@ -28,14 +28,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-@cross_process_ring
-def test_two_process_jax_distributed():
+def _run_cluster(n_procs, devs, legs, extra_env=None, timeout_s=_TIMEOUT_S):
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
-    # The children pick their own XLA_FLAGS (2 devices each); drop the
-    # 8-device flag this pytest process injected via conftest.
+    # The children pick their own XLA_FLAGS (DDL_MH_DEVS devices each);
+    # drop the 8-device flag this pytest process injected via conftest.
     env.pop("XLA_FLAGS", None)
+    env.update(
+        DDL_MH_PROCS=str(n_procs), DDL_MH_DEVS=str(devs), DDL_MH_LEGS=legs,
+        **(extra_env or {}),
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, _PROG, str(i), coord],
@@ -44,12 +46,12 @@ def test_two_process_jax_distributed():
             text=True,
             env=env,
         )
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=_TIMEOUT_S)
+            out, _ = p.communicate(timeout=timeout_s)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -61,3 +63,35 @@ def test_two_process_jax_distributed():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} rc={p.returncode}:\n{out}"
         assert f"MULTIHOST OK process={i}" in out, out
+
+
+@pytest.mark.slow
+@cross_process_ring
+def test_two_process_jax_distributed():
+    _run_cluster(2, 2, "core,stream,packed")
+
+
+@pytest.mark.slow
+@cross_process_ring
+def test_four_process_one_device_each(tmp_path):
+    """The reference's np=4 shape exactly (4 ranks, 1 device each):
+    cross-host coverage + GSPMD step + device shuffle, then a multihost
+    checkpoint→fresh-restore→loader-fast-forward resume round trip on a
+    shared dir (VERDICT r4 item 6)."""
+    _run_cluster(
+        4, 1, "core,ckpt",
+        extra_env={"DDL_MH_DIR": str(tmp_path / "mh-ckpt")},
+        timeout_s=_TIMEOUT_S + 180,
+    )
+
+
+@pytest.mark.slow
+@cross_process_ring
+def test_four_process_two_devices_each(tmp_path):
+    """4 hosts × 2 devices (8 global devices): the core leg at twice the
+    2×2 scale plus the dp×sp global-mesh loader leg — ring attention
+    over each host's sp pair, dp gradient psum across hosts."""
+    _run_cluster(
+        4, 2, "core,dpsp",
+        timeout_s=_TIMEOUT_S + 180,
+    )
