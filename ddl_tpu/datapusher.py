@@ -135,15 +135,6 @@ class DataPusher:
                 init_ret.nData * meta.global_shuffle_fraction_exchange
             )
             if num_exchange > 0:
-                if rejoin_ring is not None:
-                    # The exchange schedule of the OTHER instances'
-                    # pushers has advanced past the replay; a respawned
-                    # pusher cannot rejoin it consistently.
-                    raise DoesNotMatchError(
-                        producer_idx,
-                        "elastic respawn is not supported with global "
-                        "shuffle",
-                    )
                 if self.inplace_fill:
                     # The exchange would operate on nslots-stale slot
                     # content and its result would then be destroyed by
@@ -161,6 +152,35 @@ class DataPusher:
                     num_exchange=num_exchange,
                     exchange_method=meta.exchange_method,
                 )
+                if rejoin_ring is not None:
+                    # Rejoining a LIVE exchange needs POSITIVE capability:
+                    # a replay-capable shuffler (round re-entry over a
+                    # retention fabric — ThreadExchangeShuffler over
+                    # Rendezvous/ShmRendezvous advertises it) and a ring
+                    # deep enough that the last committed window cannot
+                    # share a slot with the predecessor's in-flight
+                    # (possibly torn) fill.  Anything else fails HERE, at
+                    # handshake — as the pre-replay code did — instead of
+                    # timing out at runtime or desyncing the schedule.
+                    if not getattr(
+                        self.shuffler, "supports_elastic_replay", False
+                    ):
+                        raise DoesNotMatchError(
+                            type(self.shuffler).__name__,
+                            "elastic respawn with global shuffle needs a "
+                            "replay-capable shuffler (consumed-box "
+                            "retention + round re-entry); this one does "
+                            "not advertise supports_elastic_replay",
+                        )
+                    if nslots < 2:
+                        raise DoesNotMatchError(
+                            nslots,
+                            "elastic respawn with global shuffle needs "
+                            "nslots >= 2: with one slot the last "
+                            "committed window shares the slot the "
+                            "predecessor was filling when it died, so "
+                            "the state restore could read a torn fill",
+                        )
                 # Fail LOUDLY at handshake when the shuffler's fabric
                 # declares a span too narrow to reach its exchange
                 # partners, instead of every producer stalling against a
@@ -231,6 +251,29 @@ class DataPusher:
                     self.callbacks, "fast_forward", n=done,
                     my_ary=self.my_ary,
                 )
+                if self.shuffler is not None:
+                    # fast_forward regenerates the LOCAL data stream (and
+                    # RNG position), but lanes exchanged IN by peers over
+                    # past rounds are not locally recoverable.  The last
+                    # committed ring slot holds the predecessor's exact
+                    # post-iteration my_ary (copy-fill is guaranteed
+                    # here — shuffle + inplace_fill is rejected above,
+                    # and slots are only ever overwritten by this
+                    # producer), so restore the full state from it.
+                    np.copyto(
+                        self.my_ary,
+                        self._slot_array((done - 1) % self.ring.nslots),
+                    )
+            if self.shuffler is not None:
+                # Re-enter the exchange schedule at the committed round:
+                # the permutation is a pure function of (seed, round),
+                # the round is in every mailbox key (tag = 2*round), and
+                # consumed round mailboxes are RETAINED by the fabric
+                # (Rendezvous/ShmRendezvous take keeps a replay copy
+                # until the next round retires it) — so replaying the
+                # death round's exchange is idempotent whether or not
+                # the predecessor completed it.
+                self.shuffler._round = done
             self._iteration = done
             logger.info(
                 "producer %d: rejoined ring at window %d",

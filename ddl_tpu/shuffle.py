@@ -113,6 +113,7 @@ class Rendezvous:
     def __init__(self) -> None:
         self._lock = threading.Condition()
         self._boxes: Dict[Tuple[int, int, int], np.ndarray] = {}
+        self._done: Dict[Tuple[int, int, int], np.ndarray] = {}
 
     def put(self, key: Tuple[int, int, int], rows: np.ndarray) -> None:
         with self._lock:
@@ -126,10 +127,20 @@ class Rendezvous:
         ``should_abort`` (e.g. the ring's shutdown flag) and raises
         :class:`ShutdownRequested` instead of stranding the producer for
         the full timeout (the §3.5 any-time-cancellability property the
-        ring waits already have)."""
+        ring waits already have).
+
+        Consumed boxes are RETAINED (moved to a done-set) until
+        :meth:`retire`: a respawned producer replaying its crashed
+        predecessor's round takes the same key again and must see the
+        same rows (elastic × shuffle — the exchange becomes idempotent
+        per (key, round)).  Bounded: the shuffler retires round r-1's
+        keys when round r starts.
+        """
         deadline = time.monotonic() + timeout_s
         with self._lock:
             while key not in self._boxes:
+                if key in self._done:  # replayed take (respawned producer)
+                    return self._done[key]
                 if should_abort is not None and should_abort():
                     raise ShutdownRequested()
                 remaining = deadline - time.monotonic()
@@ -138,11 +149,24 @@ class Rendezvous:
                         f"exchange rendezvous timed out waiting for {key}"
                     )
                 self._lock.wait(timeout=min(0.1, remaining))
-            return self._boxes.pop(key)
+            rows = self._boxes.pop(key)
+            self._done[key] = rows
+            return rows
 
     def discard(self, key: Tuple[int, int, int]) -> None:
         """Best-effort removal of a posted box (abort-path cleanup)."""
         with self._lock:
+            self._boxes.pop(key, None)
+
+    def retire(self, key: Tuple[int, int, int]) -> None:
+        """Drop a consumed box from the done-set (the round can no longer
+        be replayed once its successor round has begun).  Also drops a
+        LIVE box under the same key: at retire time the reader has long
+        consumed the original, so a live box can only be a respawned
+        partner's replayed re-put (which nobody will ever take — tags
+        are monotonic) and would otherwise leak."""
+        with self._lock:
+            self._done.pop(key, None)
             self._boxes.pop(key, None)
 
 
@@ -283,8 +307,23 @@ class ShmRendezvous:
     def take(self, key: Tuple[int, int, int], timeout_s: float = 60.0,
              should_abort: Optional[Callable[[], bool]] = None) -> np.ndarray:
         """Blocking take with the same abort semantics as
-        :meth:`Rendezvous.take` (a shutting-down peer may never post)."""
+        :meth:`Rendezvous.take` (a shutting-down peer may never post).
+
+        Consumed mailboxes are RETAINED as ``<name>.done`` (atomic
+        rename) until :meth:`retire` — a respawned producer replaying
+        its crashed predecessor's round re-takes the same key and must
+        see the same rows (see :meth:`Rendezvous.take`)."""
         path = self._path(key)
+        done = f"{path}.done"
+        # Replay probe ONCE, before the wait loop: a retained copy can
+        # only exist before this take starts (each key has a single
+        # reader lineage — the respawn replacing a dead predecessor),
+        # so re-probing per spin would just double the poll syscalls.
+        try:
+            with open(done, "rb") as f:
+                return np.load(f)
+        except FileNotFoundError:
+            pass
         deadline = time.monotonic() + timeout_s
         sleep_s = 0.0002
         while True:
@@ -293,7 +332,7 @@ class ShmRendezvous:
             try:
                 with open(path, "rb") as f:
                     rows = np.load(f)
-                os.unlink(path)
+                os.replace(path, done)  # retained for replay, not unlinked
                 return rows
             except FileNotFoundError:
                 pass
@@ -310,6 +349,17 @@ class ShmRendezvous:
             os.unlink(self._path(key))
         except OSError:
             pass
+
+    def retire(self, key: Tuple[int, int, int]) -> None:
+        """Drop the retained ``.done`` copy (replay window closed) and any
+        live box under the same key — at retire time a live box can only
+        be a respawned partner's replayed re-put, never taken (tags are
+        monotonic), which would otherwise leak until ``cleanup()``."""
+        for victim in (f"{self._path(key)}.done", self._path(key)):
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
 
     def cleanup(self) -> None:
         """Remove the whole session directory (post-run, best effort)."""
@@ -354,6 +404,15 @@ class ThreadExchangeShuffler:
         handshake."""
         return getattr(self._rdv, "span", "thread")
 
+    @property
+    def supports_elastic_replay(self) -> bool:
+        """True when the fabric retains consumed boxes for replay
+        (``retire`` is the capability marker): the pusher allows a
+        respawned producer to rejoin the exchange schedule only behind
+        this — a fabric without retention would strand the replayed
+        take until timeout (see DataPusher's rejoin handshake)."""
+        return hasattr(self._rdv, "retire")
+
     def global_shuffle(self, my_ary: np.ndarray, should_abort: Any = None,
                        **kwargs: Any) -> None:
         n = self.topology.n_instances
@@ -364,6 +423,13 @@ class ThreadExchangeShuffler:
         pinv = inverse_permutation(p)
         lane_a, lane_b = exchange_slices(self.num_exchange)
         tag = self._round * 2
+        # Round r-1's replay window closes now: retire the retained
+        # copies of the boxes this producer consumed last round (fabrics
+        # without retention, e.g. custom user fabrics, are skipped).
+        retire = getattr(self._rdv, "retire", None)
+        if retire is not None and self._round > 0:
+            retire((self.producer_idx, tag - 2, me))
+            retire((self.producer_idx, tag - 1, me))
         # Lane A forward: i -> p[i]; lane B backward: i -> pinv[i].
         for lane, dest, src, t in (
             (lane_a, int(p[me]), int(pinv[me]), tag),

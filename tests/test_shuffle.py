@@ -169,6 +169,26 @@ class TestShmRendezvous:
         assert time.monotonic() - t0 < 5.0
         rdv.cleanup()
 
+    def test_take_retains_for_replay_until_retired(self, tmp_path):
+        """Elastic × shuffle contract: a consumed mailbox stays readable
+        (``.done``) so a respawned producer replaying its predecessor's
+        round takes the SAME rows; retire() closes the replay window."""
+        from ddl_tpu.exceptions import DDLError
+        from ddl_tpu.shuffle import Rendezvous, ShmRendezvous
+
+        for rdv in (Rendezvous(), ShmRendezvous("t-replay", root=str(tmp_path))):
+            rows = np.arange(6, dtype=np.float32).reshape(2, 3)
+            rdv.put((1, 4, 0), rows)
+            first = rdv.take((1, 4, 0), timeout_s=5)
+            np.testing.assert_array_equal(first, rows)
+            # Replayed take (the respawn path): same rows, no blocking.
+            np.testing.assert_array_equal(
+                rdv.take((1, 4, 0), timeout_s=5), rows
+            )
+            rdv.retire((1, 4, 0))
+            with pytest.raises(DDLError):
+                rdv.take((1, 4, 0), timeout_s=0.2)
+
     def test_stale_session_sweep(self, tmp_path):
         """A crashed run's RAM-backed mailbox dir is reclaimed once its
         minting pid is dead AND it is old; a live run's dir survives any
@@ -331,6 +351,75 @@ class TestSpanRejection:
                 topo, ThreadExchangeShuffler.factory(rendezvous=rdv)
             )
         rdv.cleanup()
+
+    def test_rejoin_requires_replay_capable_shuffler(self, tmp_path):
+        """Elastic rejoin + shuffle is gated on POSITIVE capability: a
+        fabric without consumed-box retention (no retire) fails at
+        handshake with the clear old-style error, not a runtime
+        timeout; a retention fabric with nslots=1 is rejected too (the
+        one-slot restore could read the predecessor's torn in-flight
+        fill)."""
+        from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+        from ddl_tpu.datapusher import DataPusher
+        from ddl_tpu.exceptions import DoesNotMatchError
+        from ddl_tpu.shuffle import ShmRendezvous, make_session
+        from ddl_tpu.transport.connection import (
+            ProducerConnection, ThreadChannel,
+        )
+        from ddl_tpu.transport.ring import ThreadRing
+        from ddl_tpu.types import MetaData_Consumer_To_Producer
+
+        class P(ProducerFunctionSkeleton):
+            def on_init(self, **kw):
+                return DataProducerOnInitReturn(
+                    nData=16, nValues=2, shape=(16, 2), splits=(1, 1)
+                )
+
+            def post_init(self, my_ary, **kw):
+                my_ary[:] = 0.0
+
+        class NoRetentionFabric:
+            """put/take/discard only — the pre-replay fabric interface."""
+
+            span = "thread"
+
+            def put(self, key, rows):
+                pass
+
+            def take(self, key, timeout_s=60.0, should_abort=None):
+                raise AssertionError("never reached")
+
+            def discard(self, key):
+                pass
+
+        def handshake(factory, nslots=2):
+            cons_end, prod_end = ThreadChannel.pair()
+            cons_end.send(MetaData_Consumer_To_Producer(
+                data_producer_function=P(), batch_size=8, n_epochs=1,
+                global_shuffle_fraction_exchange=0.5,
+                exchange_method="sendrecv_replace",
+            ))
+            topo = Topology(n_instances=2, instance_idx=0, n_producers=1,
+                            mode=RunMode.THREAD)
+            return DataPusher(
+                ProducerConnection(prod_end, 1, cross_process=False),
+                topo, 1, nslots=nslots, shuffler_factory=factory,
+                rejoin_ring=ThreadRing(nslots, 16 * 2 * 4),
+            )
+
+        with pytest.raises(DoesNotMatchError, match="supports_elastic_replay"):
+            handshake(
+                ThreadExchangeShuffler.factory(rendezvous=NoRetentionFabric())
+            )
+        with pytest.raises(DoesNotMatchError, match="nslots >= 2"):
+            handshake(
+                ThreadExchangeShuffler.factory(
+                    rendezvous=ShmRendezvous(
+                        make_session("t-one-slot"), root=str(tmp_path)
+                    )
+                ),
+                nslots=1,
+            )
 
     def test_process_mode_accepts_shm_rendezvous(self):
         from ddl_tpu.shuffle import ShmRendezvous, make_session
