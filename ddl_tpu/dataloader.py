@@ -13,10 +13,12 @@ with ``__len__`` / ``__getitem__`` / ``mark`` (``mpi_dataloader.py:107-241``):
   are driven off the marks (reference ``mpi_dataloader.py:89-102``).
 
 Fixes over the reference: unequal ``batches_per_window`` across producers is
-rejected at handshake instead of deadlocking later (Q6, reference ToDo at
-``mpi_dataloader.py:223``); single-process THREAD mode is first-class rather
-than a silent empty loader (Q9, ``mpi_dataloader.py:173-174``); output can
-be numpy views, torch tensors, or JAX device arrays (device ingest).
+SERVED (weighted rotation — each turn drains the whole current window, so
+``len(loader)`` tracks the rotation) where the reference left mixed sizes
+as an unfinished deadlocking ToDo (Q6, ``mpi_dataloader.py:223``);
+single-process THREAD mode is first-class rather than a silent empty
+loader (Q9, ``mpi_dataloader.py:173-174``); output can be numpy views,
+torch tensors, or JAX device arrays (device ingest).
 """
 
 from __future__ import annotations
@@ -75,6 +77,7 @@ class DistributedDataLoader:
         self.timeout_s = timeout_s
         self._epoch = 0
         self._batches_in_window = 0
+        self._served_in_epoch = 0
         self._target = 0  # index into connection.rings, round-robin
         self._cur_slot: Optional[int] = None
         self._cur_array: Optional[np.ndarray] = None
@@ -101,17 +104,15 @@ class DistributedDataLoader:
         replies = connection.recv_metadata_as_consumer()
         if not replies:
             raise DoesNotMatchError(0, "no producers connected")
-        bpw = {r.batches_per_window for r in replies}
-        if len(bpw) != 1:
-            # The reference deadlocked here at runtime (Q6, its ToDo at
-            # mpi_dataloader.py:223); we reject at handshake.
-            raise DoesNotMatchError(
-                sorted(bpw),
-                "all producers must report equal batches_per_window",
-            )
         self.replies = replies
-        self.batches_per_window = replies[0].batches_per_window
-        self._len = self.batches_per_window  # Q7-compatible epoch length
+        # Per-producer epoch lengths: UNEQUAL batches_per_window is
+        # served by weighted rotation — each producer's turn serves its
+        # WHOLE window, so a bigger window simply makes a longer epoch
+        # (len(self) tracks the current target).  The reference left
+        # mixed sizes as an unfinished ToDo that deadlocked its token
+        # protocol (Q6, reference mpi_dataloader.py:223); rotation has
+        # no tokens to mismatch.
+        self._lens = [r.batches_per_window for r in replies]
         # Geometry is per-producer: heterogeneous column layouts are served
         # correctly rather than silently mis-split with producer 0's spec.
         self.splits_per_producer = [tuple(r.splits) for r in replies]
@@ -133,14 +134,36 @@ class DistributedDataLoader:
     def epoch(self) -> int:
         return self._epoch
 
+    @property
+    def batches_per_window(self) -> int:
+        """Epoch length of the CURRENT target producer (Q7: one epoch ==
+        one window).  With mixed window sizes this changes as the
+        rotation advances — read it per epoch, as ``Trainer.fit`` does
+        for its per-geometry scan cache."""
+        return self._lens[self._target]
+
     def __len__(self) -> int:
-        return self._len
+        return self._lens[self._target]
 
     def _host_batch(self, idx: int) -> np.ndarray:
         """Zero-copy view of batch ``idx`` in the current window."""
         if not isinstance(idx, (int, np.integer)):
             raise ValueError(f"index must be int, got {type(idx)}")
-        if idx < 0 or idx >= self._len:
+        if (
+            self._cur_array is None
+            and self._batches_in_window == 0
+            and self._served_in_epoch
+        ):
+            # This epoch's window has been fully served and released
+            # (marks rotated the target); the next window belongs to the
+            # NEXT epoch (Q7: one epoch == one window).  Ending
+            # iteration here is what bounds a `for` loop when the NEXT
+            # producer's window is longer than the one just served —
+            # with equal windows the idx bound below fired at the same
+            # point, with mixed windows it would keep indexing into the
+            # rotated-to window mid-epoch.
+            raise IndexError(idx)
+        if idx < 0 or idx >= self._lens[self._target]:
             raise IndexError(idx)
         if self._finalized:
             raise RuntimeError("loader is finalized")
@@ -150,6 +173,7 @@ class DistributedDataLoader:
         start = self.batch_size * idx
         batch = self._cur_array[start : start + self.batch_size]
         self.metrics.incr("consumer.samples", self.batch_size)
+        self._served_in_epoch += 1
         return batch
 
     def _host_cols(self, idx: int) -> Tuple[np.ndarray, ...]:
@@ -197,7 +221,7 @@ class DistributedDataLoader:
         splits = self.splits_per_producer[self._target]
 
         def host_iter():
-            for idx in range(self._len):
+            for idx in range(self._lens[self._target]):
                 yield self._host_batch(idx)
 
         return PrefetchIterator(
@@ -292,11 +316,13 @@ class DistributedDataLoader:
                 slot = ring.acquire_drain_ahead(held[target], timeout_s)
             arr = self._slot_array(target, slot)
             # Ragged tail rows (nData not a batch multiple) are unserved,
-            # exactly as in batch iteration.
-            served = self.batches_per_window * self.batch_size
+            # exactly as in batch iteration.  bpw is per-TARGET: mixed
+            # window sizes yield differently-shaped windows as the
+            # rotation advances.
+            bpw = self._lens[target]
+            served = bpw * self.batch_size
             window = arr[:served].reshape(
-                self.batches_per_window, self.batch_size,
-                *self.shapes[target][1:]
+                bpw, self.batch_size, *self.shapes[target][1:]
             )
             # Byte accounting is deferred to finish(): counting bytes at
             # completion keeps ingest.bytes and consumer.samples covering
@@ -374,13 +400,14 @@ class DistributedDataLoader:
 
     def _on_batch_end(self) -> None:
         self._batches_in_window += 1
-        if self._batches_in_window >= self.batches_per_window:
+        if self._batches_in_window >= self._lens[self._target]:
             self._batches_in_window = 0
             self._release_current()
             self._advance_to_next_producer()
             # Next window is acquired lazily by the next __getitem__.
 
     def _on_epoch_end(self) -> None:
+        self._served_in_epoch = 0
         if self._batches_in_window:
             # Epoch ended mid-window (user broke out early): discard the
             # partially consumed window so the next epoch starts on a fresh

@@ -171,16 +171,19 @@ class Trainer:
         mode: Optional[str] = None,
         output: str = "numpy",
         window_stream: bool = False,
+        n_epochs: int = 1,
     ) -> float:
-        """One-epoch metric pass over a (held-out) producer's windows.
+        """Metric pass over a (held-out) producer's windows.
 
-        Drains exactly one epoch (one window per producer rotation — the
-        Q7 epoch) computing ``metric_fn(params, batch) -> scalar`` per
-        batch and returns the mean.  Uses the same producer/consumer
-        machinery as ``fit`` but runs no optimizer step — e.g. pass
-        ``models.vit.accuracy`` for classification eval.
-        ``window_stream=True`` (``output="jax"``): the window streams
-        zero-copy and all its batches evaluate in one jitted scan.
+        Drains ``n_epochs`` epochs (one window per producer rotation —
+        the Q7 epoch; pass ``n_epochs=n_producers`` to cover every
+        producer once) computing ``metric_fn(params, batch) -> scalar``
+        per batch and returns the sample-weighted mean.  Uses the same
+        producer/consumer machinery as ``fit`` but runs no optimizer
+        step — e.g. pass ``models.vit.accuracy`` for classification
+        eval.  ``window_stream=True`` (``output="jax"``): each window
+        streams zero-copy and all its batches evaluate in one jitted
+        scan.
         """
         from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
 
@@ -206,7 +209,7 @@ class Trainer:
                 producer_function,
                 batch_size=batch_size,
                 connection=env.connection,
-                n_epochs=1,
+                n_epochs=n_epochs,
                 output=output,
                 metrics=trainer.metrics,
                 **lkw,
@@ -225,25 +228,30 @@ class Trainer:
 
                 vals = []
                 for win in loader.windows():
-                    vals.append(window_metric(state.params, win))
+                    # Weight each window's mean by its batch count: with
+                    # mixed batches_per_window across producers (served
+                    # by weighted rotation), a plain mean-of-means would
+                    # overweight small windows.
+                    vals.append((window_metric(state.params, win),
+                                 win.shape[0]))
                     loader.mark(Marker.END_OF_EPOCH)
-                fvals = [float(v) for v in vals]
-                # Mean of per-window means == global batch mean ONLY
-                # because every window holds the same number of batches —
-                # an invariant the loader enforces at handshake
-                # (DistributedDataLoader rejects unequal
-                # batches_per_window, dataloader.py:103-112) and again at
-                # elastic rejoin (connection.rejoin_producer geometry
-                # check), so it cannot be violated here.
-                return sum(fvals) / len(fvals) if fvals else float("nan")
-            it = loader.prefetch(2) if output == "jax" else loader
+                total = sum(w for _, w in vals)
+                return (
+                    sum(float(v) * w for v, w in vals) / total
+                    if total else float("nan")
+                )
             vals: List[Any] = []
-            for batch in it:
-                # Keep metrics as device arrays; a float() here would
-                # serialise loading against compute (see fit).
-                vals.append(metric_fn(state.params, batch))
-                loader.mark(Marker.END_OF_BATCH)
-            loader.mark(Marker.END_OF_EPOCH)
+            for _epoch in range(n_epochs):
+                it = loader.prefetch(2) if output == "jax" else loader
+                for batch in it:
+                    # Keep metrics as device arrays; a float() here would
+                    # serialise loading against compute (see fit).
+                    vals.append(metric_fn(state.params, batch))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            # Batches all hold batch_size samples, so a plain mean over
+            # batches IS the sample-weighted mean even with mixed
+            # window sizes.
             fvals = [float(v) for v in vals]
             return sum(fvals) / len(fvals) if fvals else float("nan")
 
@@ -271,21 +279,28 @@ class Trainer:
         from ddl_tpu.parallel.train import make_multistep
 
         col_splits = _stream_splits(loader)
-        multi_fn = self._multistep_cache.get(loader.batches_per_window)
-        if multi_fn is None:
-            _, multi_fn = make_multistep(
-                self._loss_fn, self._optimizer, self.mesh,
-                self._param_specs, batch_spec=self._batch_spec,
-                n_steps=loader.batches_per_window,
-                accum_steps=self._accum_steps,
-            )
-            self._multistep_cache[loader.batches_per_window] = multi_fn
+
+        def multi_for(n_steps: int):
+            # Resolved PER WINDOW: with mixed batches_per_window across
+            # producers, windows of different depths arrive as the
+            # rotation advances, each needing its own scan length
+            # (compiled once per distinct depth, cached).
+            fn = self._multistep_cache.get(n_steps)
+            if fn is None:
+                _, fn = make_multistep(
+                    self._loss_fn, self._optimizer, self.mesh,
+                    self._param_specs, batch_spec=self._batch_spec,
+                    n_steps=n_steps, accum_steps=self._accum_steps,
+                )
+                self._multistep_cache[n_steps] = fn
+            return fn
+
         pending = None
         epoch = start_epoch
         for win in loader.windows():
             if window_hook is not None:
                 win = window_hook(win)
-            state, losses = multi_fn(
+            state, losses = multi_for(win.shape[0])(
                 state, _window_cols(win, col_splits), per_step=True
             )
             if pending is not None:

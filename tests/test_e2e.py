@@ -165,22 +165,59 @@ class TestThreadModeE2E:
         main()
 
 
-class TestHandshakeValidation:
-    def test_unequal_batches_per_window_rejected(self):
-        """Q6 fix: the reference deadlocked; we reject at handshake."""
+class TestMixedWindowSizes:
+    """Unequal batches_per_window across producers is SERVED by weighted
+    rotation (the reference's unfinished deadlocking ToDo, Q6 at its
+    mpi_dataloader.py:223): each producer's turn drains its whole
+    window, so epochs alternate between the two lengths and both
+    producers drain fully without deadlock."""
 
+    def test_mixed_sizes_drain_fully(self):
         @distributed_dataloader(n_producers=2, mode="thread")
         def main(env):
-            return DistributedDataLoader(
+            # Producer 1: 64 rows -> 4 batches; producer 2: 128 -> 8.
+            loader = DistributedDataLoader(
                 TaggedProducer(bad_ndata_for=2), batch_size=16,
-                connection=env.connection, n_epochs=1,
+                connection=env.connection, n_epochs=4, output="numpy",
             )
+            lens, counts, tags = [], [], []
+            for _ in range(4):
+                lens.append(len(loader))
+                n = 0
+                for feats, _ in loader:
+                    n += 1
+                    tags.append(int(feats[0, 1]))  # col1: pure producer idx
+                    loader.mark(Marker.END_OF_BATCH)
+                counts.append(n)
+                loader.mark(Marker.END_OF_EPOCH)
+            return lens, counts, tags
 
-        from ddl_tpu.exceptions import DoesNotMatchError
+        lens, counts, tags = main()
+        # len(loader) tracks the rotation; every window drains fully.
+        assert lens == [4, 8, 4, 8], lens
+        assert counts == lens, counts
+        assert sorted(set(tags)) == [1, 2]
 
-        with pytest.raises(DoesNotMatchError):
-            main()
+    def test_mixed_sizes_window_stream_shapes(self):
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(bad_ndata_for=2), batch_size=16,
+                connection=env.connection, n_epochs=4, output="jax",
+            )
+            shapes = []
+            for win in loader.windows():
+                shapes.append(tuple(win.shape))
+                loader.mark(Marker.END_OF_EPOCH)
+            return shapes
 
+        shapes = main()
+        assert shapes == [
+            (4, 16, 4), (8, 16, 4), (4, 16, 4), (8, 16, 4),
+        ], shapes
+
+
+class TestHandshakeValidation:
     def test_producer_on_init_error_reaches_consumer(self):
         class Broken(ProducerFunctionSkeleton):
             def on_init(self, **kw):

@@ -167,6 +167,38 @@ def test_fit_window_hook_device_shuffler(rng):
     assert all(np.isfinite(l) for l in res.losses)
 
 
+def test_fit_window_stream_mixed_window_sizes(rng):
+    """Mixed batches_per_window through the streamed Trainer: windows of
+    different depths each get their own cached multistep scan, and the
+    fit completes with finite losses (the reference's unfinished Q6
+    ToDo, now served end-to-end)."""
+    from ddl_tpu import DataProducerOnInitReturn, ProducerFunctionSkeleton
+
+    class MixedProducer(ProducerFunctionSkeleton):
+        def on_init(self, producer_idx=0, **kw):
+            self._rng = np.random.default_rng(producer_idx)
+            rows = 32 if producer_idx == 1 else 64  # bpw 2 vs 4 at batch 16
+            return DataProducerOnInitReturn(
+                nData=rows, nValues=6, shape=(rows, 6), splits=(3, 2, 1),
+            )
+
+        def post_init(self, my_ary, **kw):
+            my_ary[:] = self._rng.random(my_ary.shape)
+
+        def execute_function(self, my_ary, **kw):
+            my_ary[:] = self._rng.random(my_ary.shape)
+
+    _, trainer = _make_trainer()
+    res = trainer.fit(
+        MixedProducer(), batch_size=16, n_epochs=4, n_producers=2,
+        mode="thread", output="jax", window_stream=True,
+    )
+    assert len(res.losses) == 4
+    assert all(np.isfinite(l) for l in res.losses), res.losses
+    # One compiled scan per distinct window depth.
+    assert sorted(trainer._multistep_cache) == [2, 4]
+
+
 def test_fit_pipeline_parallel_llama(rng):
     """Trainer integration for pipeline parallelism (VERDICT r4 item 4):
     the pipelined llama loss + pp param specs drop into Trainer.fit's
