@@ -164,13 +164,16 @@ class DataPusher:
                     # timing out at runtime or desyncing the schedule.
                     if not getattr(
                         self.shuffler, "supports_elastic_replay", False
+                    ) or not callable(
+                        getattr(self.shuffler, "rejoin", None)
                     ):
                         raise DoesNotMatchError(
                             type(self.shuffler).__name__,
                             "elastic respawn with global shuffle needs a "
                             "replay-capable shuffler (consumed-box "
-                            "retention + round re-entry); this one does "
-                            "not advertise supports_elastic_replay",
+                            "retention + a rejoin(round) re-entry "
+                            "method); this one does not advertise "
+                            "supports_elastic_replay / rejoin",
                         )
                     if nslots < 2:
                         raise DoesNotMatchError(
@@ -272,8 +275,10 @@ class DataPusher:
                 # (Rendezvous/ShmRendezvous take keeps a replay copy
                 # until the next round retires it) — so replaying the
                 # death round's exchange is idempotent whether or not
-                # the predecessor completed it.
-                self.shuffler._round = done
+                # the predecessor completed it.  rejoin() is part of the
+                # capability contract checked above — never a private
+                # field poke.
+                self.shuffler.rejoin(done)
             self._iteration = done
             logger.info(
                 "producer %d: rejoined ring at window %d",

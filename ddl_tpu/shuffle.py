@@ -396,6 +396,10 @@ class ThreadExchangeShuffler:
         self.seed = seed
         self._rdv = rendezvous or _default_rendezvous
         self._round = 0
+        # Outgoing keys of the last two rounds: swept when their replay
+        # window closes (see global_shuffle) so a respawned producer's
+        # re-put of an already-consumed box cannot leak past two rounds.
+        self._sent: List[Tuple[int, Tuple[int, int, int]]] = []
 
     @property
     def span(self) -> str:
@@ -412,6 +416,14 @@ class ThreadExchangeShuffler:
         this — a fabric without retention would strand the replayed
         take until timeout (see DataPusher's rejoin handshake)."""
         return hasattr(self._rdv, "retire")
+
+    def rejoin(self, round_: int) -> None:
+        """Re-enter the exchange schedule at ``round_`` (elastic rejoin:
+        the ring-committed window count).  Part of the
+        ``supports_elastic_replay`` contract — the pusher calls THIS,
+        never a private round field, so a conforming custom shuffler
+        implements its own round re-entry here."""
+        self._round = int(round_)
 
     def global_shuffle(self, my_ary: np.ndarray, should_abort: Any = None,
                        **kwargs: Any) -> None:
@@ -430,6 +442,19 @@ class ThreadExchangeShuffler:
         if retire is not None and self._round > 0:
             retire((self.producer_idx, tag - 2, me))
             retire((self.producer_idx, tag - 1, me))
+        # Sweep OUR outgoing boxes whose replay window has closed: in the
+        # normal case the partner consumed them (no-op), but a respawned
+        # producer's re-put of a box its partner had already taken AND
+        # retired would otherwise linger forever (the partner retires
+        # each incoming key exactly once).
+        if self._sent:
+            live = []
+            for r, key in self._sent:
+                if r <= self._round - 2:
+                    self._rdv.discard(key)
+                else:
+                    live.append((r, key))
+            self._sent = live
         # Lane A forward: i -> p[i]; lane B backward: i -> pinv[i].
         for lane, dest, src, t in (
             (lane_a, int(p[me]), int(pinv[me]), tag),
@@ -437,6 +462,7 @@ class ThreadExchangeShuffler:
         ):
             put_key = (self.producer_idx, t, dest)
             self._rdv.put(put_key, my_ary[lane].copy())
+            self._sent.append((self._round, put_key))
             try:
                 my_ary[lane] = self._rdv.take(
                     (self.producer_idx, t, me), should_abort=should_abort
