@@ -23,8 +23,11 @@ killing the bench, and the JSON line is emitted even on partial failure
 with an ``errors`` field.
 
 Env knobs: DDL_BENCH_PLATFORM=tpu|cpu (skip probing), DDL_BENCH_MODE=
-ingest|train|all|big (default all; "big" runs ONLY the HBM-filling
-train config), DDL_BENCH_PROBE_TIMEOUT_S (default 300).
+ingest|train|all|big|stream (default all; "big" runs ONLY the
+HBM-filling train config, "stream" ONLY the window-stream configs —
+the chip-checklist window-size sweep), DDL_BENCH_PROBE_TIMEOUT_S
+(default 300), DDL_BENCH_STREAM_MIB / DDL_BENCH_LOOKAHEAD /
+DDL_BENCH_NSLOTS (stream geometry).
 """
 
 from __future__ import annotations
@@ -166,8 +169,17 @@ try:  # import lazily-guarded so `import bench` works before deps resolve
 
     # Stream-config geometry: big windows amortize per-transfer cost (the
     # link saturates only at >=8 MiB per put — tools/probe_ingest.py).
-    N_DATA_STREAM = 32768  # 32 MiB windows
+    # DDL_BENCH_STREAM_MIB sweeps the window size (utilization-gap
+    # diagnosis, VERDICT r4 item 2); DDL_BENCH_LOOKAHEAD deepens the
+    # stream pipeline (pair with DDL_BENCH_NSLOTS >= lookahead+1).
+    STREAM_MIB = int(os.environ.get("DDL_BENCH_STREAM_MIB", "32"))
+    # Rounded to a whole number of batches (serving truncates ragged tails).
+    N_DATA_STREAM = max(
+        BATCH, STREAM_MIB * (1 << 20) // (N_VALUES * 4) // BATCH * BATCH
+    )
     EPOCHS_STREAM = 16
+    STREAM_LOOKAHEAD = int(os.environ.get("DDL_BENCH_LOOKAHEAD", "1"))
+    STREAM_NSLOTS = int(os.environ.get("DDL_BENCH_NSLOTS", "2"))
 
     class StreamBenchProducer(ProducerFunctionSkeleton):
         """Zero-copy fill: writes each window straight into the ring slot
@@ -320,7 +332,9 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
     def consume(w):
         return jnp.sum(w[..., -1])
 
-    @distributed_dataloader(n_producers=N_PRODUCERS, mode=mode, nslots=2)
+    @distributed_dataloader(
+        n_producers=N_PRODUCERS, mode=mode, nslots=STREAM_NSLOTS
+    )
     def main(env):
         loader = DistributedDataLoader(
             StreamBenchProducer(), batch_size=BATCH,
@@ -331,7 +345,7 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
         samples = 0
         out = None
         seen = 0
-        for win in loader.windows():
+        for win in loader.windows(lookahead=STREAM_LOOKAHEAD):
             if seen == 2:
                 if out is not None:
                     jax.block_until_ready(out)
@@ -716,7 +730,10 @@ def main() -> None:
         "platform": platform,
     }
 
-    if mode in ("ingest", "all"):
+    if mode in ("ingest", "all", "stream"):
+        # "stream" (chip_checklist step 5's window-size sweep): ONLY the
+        # two window-stream configs + the link measure — the batch-path
+        # configs don't depend on DDL_BENCH_STREAM_MIB.
         try:
             # One link-capability measurement shared by every ingest config
             # (the denominator for BASELINE.md's utilization target).
@@ -739,40 +756,43 @@ def main() -> None:
 
             return best_valid(2, run, key=lambda r: -r[0])
 
-        try:
-            ours, north_star = _ingest_best(
-                nslots=2, n_producers=N_PRODUCERS, sync_every_batch=False,
-                use_prefetch=True, link_bytes_per_sec=link_bw,
-            )
-            result["value"] = round(ours, 1)
-            result.update(
-                samples_per_sec=round(north_star["samples_per_sec"], 1),
-                stall_fraction=round(north_star["stall_fraction"], 4),
-                ingest_bytes_per_sec=round(
-                    north_star["ingest_bytes_per_sec"], 1
-                ),
-                link_bytes_per_sec=round(
-                    north_star.get("link_bytes_per_sec", 0.0), 1
-                ),
-                bandwidth_utilization=round(
-                    north_star.get("bandwidth_utilization", 0.0), 4
-                ),
-            )
-        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
-            errors["ingest"] = f"{type(e).__name__}: {e}"
-        try:
-            # Same pipeline without the prefetch lookahead: the delta IS
-            # the prefetch win (VERDICT r2 item 5 asked for before/after).
-            no_pf, ns_no_pf = _ingest_best(
-                nslots=2, n_producers=N_PRODUCERS, sync_every_batch=False,
-                use_prefetch=False,
-            )
-            result["ingest_no_prefetch"] = {
-                "samples_per_sec": round(no_pf, 1),
-                "stall_fraction": round(ns_no_pf["stall_fraction"], 4),
-            }
-        except Exception as e:  # noqa: BLE001
-            errors["ingest_no_prefetch"] = f"{type(e).__name__}: {e}"
+        if mode != "stream":
+            try:
+                ours, north_star = _ingest_best(
+                    nslots=2, n_producers=N_PRODUCERS,
+                    sync_every_batch=False,
+                    use_prefetch=True, link_bytes_per_sec=link_bw,
+                )
+                result["value"] = round(ours, 1)
+                result.update(
+                    samples_per_sec=round(north_star["samples_per_sec"], 1),
+                    stall_fraction=round(north_star["stall_fraction"], 4),
+                    ingest_bytes_per_sec=round(
+                        north_star["ingest_bytes_per_sec"], 1
+                    ),
+                    link_bytes_per_sec=round(
+                        north_star.get("link_bytes_per_sec", 0.0), 1
+                    ),
+                    bandwidth_utilization=round(
+                        north_star.get("bandwidth_utilization", 0.0), 4
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+                errors["ingest"] = f"{type(e).__name__}: {e}"
+            try:
+                # Same pipeline without the prefetch lookahead: the delta
+                # IS the prefetch win (VERDICT r2 item 5 asked for
+                # before/after).
+                no_pf, ns_no_pf = _ingest_best(
+                    nslots=2, n_producers=N_PRODUCERS,
+                    sync_every_batch=False, use_prefetch=False,
+                )
+                result["ingest_no_prefetch"] = {
+                    "samples_per_sec": round(no_pf, 1),
+                    "stall_fraction": round(ns_no_pf["stall_fraction"], 4),
+                }
+            except Exception as e:  # noqa: BLE001
+                errors["ingest_no_prefetch"] = f"{type(e).__name__}: {e}"
         def _stream_result(stream_mode: str) -> dict:
             """One gated best-of stream measurement for ``stream_mode``
             (shared by the thread and process configs so the utilization
@@ -818,33 +838,37 @@ def main() -> None:
             _headline_util("ingest_stream_process", "stream-process")
         except Exception as e:  # noqa: BLE001
             errors["ingest_stream_process"] = f"{type(e).__name__}: {e}"
-        try:
-            # PROCESS mode: spawned producer processes over the native C++
-            # shm ring — the native transport's throughput number.
-            proc, ns_proc = _ingest_best(
-                nslots=2, n_producers=N_PRODUCERS, sync_every_batch=False,
-                mode="process", use_prefetch=True,
-            )
-            result["ingest_process_mode"] = {
-                "samples_per_sec": round(proc, 1),
-                "stall_fraction": round(ns_proc["stall_fraction"], 4),
-                "ingest_bytes_per_sec": round(
-                    ns_proc["ingest_bytes_per_sec"], 1
-                ),
-            }
-        except Exception as e:  # noqa: BLE001
-            errors["ingest_process_mode"] = f"{type(e).__name__}: {e}"
-        try:
-            # Reference design point: strict alternation, synchronous
-            # transfers (its one-window token protocol).
-            baseline, _ = _ingest_best(
-                nslots=1, n_producers=N_PRODUCERS, sync_every_batch=True
-            )
-            if result["value"]:
-                result["vs_baseline"] = round(result["value"] / baseline, 3)
-                result["baseline_samples_per_sec"] = round(baseline, 1)
-        except Exception as e:  # noqa: BLE001
-            errors["ingest_baseline"] = f"{type(e).__name__}: {e}"
+        if mode != "stream":
+            try:
+                # PROCESS mode: spawned producer processes over the native
+                # C++ shm ring — the native transport's throughput number.
+                proc, ns_proc = _ingest_best(
+                    nslots=2, n_producers=N_PRODUCERS,
+                    sync_every_batch=False,
+                    mode="process", use_prefetch=True,
+                )
+                result["ingest_process_mode"] = {
+                    "samples_per_sec": round(proc, 1),
+                    "stall_fraction": round(ns_proc["stall_fraction"], 4),
+                    "ingest_bytes_per_sec": round(
+                        ns_proc["ingest_bytes_per_sec"], 1
+                    ),
+                }
+            except Exception as e:  # noqa: BLE001
+                errors["ingest_process_mode"] = f"{type(e).__name__}: {e}"
+            try:
+                # Reference design point: strict alternation, synchronous
+                # transfers (its one-window token protocol).
+                baseline, _ = _ingest_best(
+                    nslots=1, n_producers=N_PRODUCERS, sync_every_batch=True
+                )
+                if result["value"]:
+                    result["vs_baseline"] = round(
+                        result["value"] / baseline, 3
+                    )
+                    result["baseline_samples_per_sec"] = round(baseline, 1)
+            except Exception as e:  # noqa: BLE001
+                errors["ingest_baseline"] = f"{type(e).__name__}: {e}"
 
     if mode in ("train", "all", "big"):
         train: dict = {}
@@ -919,6 +943,14 @@ def main() -> None:
 
     if errors:
         result["errors"] = errors
+    if result["value"] is None:
+        # Stream-only mode: a stream config IS the run's headline
+        # (either mode may have been gate-rejected; take the survivor).
+        for key in ("ingest_stream", "ingest_stream_process"):
+            if result.get(key):
+                result["metric"] = f"{key}_samples_per_sec"
+                result["value"] = result[key]["samples_per_sec"]
+                break
     if result["value"] is None and result.get("train_tokens_per_sec"):
         # Ingest failed but training measured: still report a headline.
         result["metric"] = "train_tokens_per_sec"

@@ -93,11 +93,19 @@ class LoaderCheckpoint:
 
     @staticmethod
     def capture(loader: Any, shuffler: Any = None) -> "LoaderCheckpoint":
+        round_ = 0
+        if shuffler is not None:
+            # Public accessor first (the rejoin/exchange_round contract);
+            # the private-field fallback keeps old duck-typed shufflers
+            # working.
+            round_ = getattr(
+                shuffler, "exchange_round", getattr(shuffler, "_round", 0)
+            )
         return LoaderCheckpoint(
             epoch=loader._epoch,
             target=loader._target,
             batches_in_window=loader._batches_in_window,
-            shuffle_round=getattr(shuffler, "_round", 0) if shuffler else 0,
+            shuffle_round=int(round_),
         )
 
     def apply(self, loader: Any, shuffler: Any = None) -> None:
@@ -105,7 +113,13 @@ class LoaderCheckpoint:
         loader._target = self.target
         loader._batches_in_window = self.batches_in_window
         if shuffler is not None:
-            shuffler._round = self.shuffle_round
+            rejoin = getattr(shuffler, "rejoin", None)
+            if callable(rejoin):
+                # The documented re-entry hook — a custom shuffler's real
+                # round state may not be named _round.
+                rejoin(self.shuffle_round)
+            else:
+                shuffler._round = self.shuffle_round
 
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

@@ -147,6 +147,16 @@ class DeviceGlobalShuffler:
     def n_instances(self) -> int:
         return self.mesh.shape[self.axis]
 
+    @property
+    def exchange_round(self) -> int:
+        """Completed exchange rounds (checkpoints read this)."""
+        return self._round
+
+    def rejoin(self, round_: int) -> None:
+        """Re-enter the schedule at ``round_`` (checkpoint resume) — the
+        same public re-entry hook the host-side shuffler exposes."""
+        self._round = int(round_)
+
     def shuffle(self, window: Any) -> Any:
         """One exchange round; returns the window with lanes exchanged."""
         n = self.n_instances

@@ -417,10 +417,17 @@ class ThreadExchangeShuffler:
         take until timeout (see DataPusher's rejoin handshake)."""
         return hasattr(self._rdv, "retire")
 
+    @property
+    def exchange_round(self) -> int:
+        """Completed exchange rounds — the public counter checkpoints
+        read (``LoaderCheckpoint.capture``)."""
+        return self._round
+
     def rejoin(self, round_: int) -> None:
         """Re-enter the exchange schedule at ``round_`` (elastic rejoin:
-        the ring-committed window count).  Part of the
-        ``supports_elastic_replay`` contract — the pusher calls THIS,
+        the ring-committed window count; checkpoint resume passes the
+        restored round).  Part of the ``supports_elastic_replay``
+        contract — the pusher and ``LoaderCheckpoint.apply`` call THIS,
         never a private round field, so a conforming custom shuffler
         implements its own round re-entry here."""
         self._round = int(round_)
@@ -446,8 +453,15 @@ class ThreadExchangeShuffler:
         # normal case the partner consumed them (no-op), but a respawned
         # producer's re-put of a box its partner had already taken AND
         # retired would otherwise linger forever (the partner retires
-        # each incoming key exactly once).
-        if self._sent:
+        # each incoming key exactly once).  ONLY safe for n == 2: there
+        # the partner is the same every round, so my reaching round r
+        # proves it completed round r-1 and consumed my r-2 boxes.  With
+        # n > 2 cross-instance round skew is unbounded (peers only
+        # synchronise with their ROUND partners) and the sweep could
+        # discard a lagging partner's still-unconsumed box, stranding it
+        # until timeout — there the re-put residual (<= 2 boxes per
+        # respawn) is left for cleanup()/the stale-session sweep.
+        if self._sent and n == 2:
             live = []
             for r, key in self._sent:
                 if r <= self._round - 2:

@@ -18,7 +18,7 @@ ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/4] probe =="
+echo "== [1/5] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -28,16 +28,32 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/4] on-chip test suite =="
+echo "== [2/5] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/4] full bench =="
+echo "== [3/5] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/4] big-model MFU bench =="
+echo "== [4/5] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
+
+echo "== [5/5] stream-bandwidth diagnosis + window-size sweep =="
+# DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
+# fail loudly (step timeout), never silently record CPU numbers in a
+# TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
+# (plus the link measure) — the non-stream ingest configs don't depend
+# on the window size and step 3 already measured them.
+DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_stream.py 32 \
+  2>&1 | tee "$ART/stream-probe-32-$STAMP.txt" | tail -8
+for MIB in 64 128; do
+  DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=stream \
+    DDL_BENCH_STREAM_MIB=$MIB DDL_BENCH_LOOKAHEAD=2 DDL_BENCH_NSLOTS=3 \
+    timeout 1200 python bench.py \
+    2> "$ART/bench-stream-$MIB-$STAMP.err" \
+    | tee "$ART/bench-stream-$MIB-$STAMP.json"
+done
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="
