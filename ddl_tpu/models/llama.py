@@ -227,15 +227,18 @@ def _layer_apply(
 
 
 def _attn_qkv(layer: Params, h: jax.Array, cfg: LlamaConfig,
-              positions: jax.Array):
-    """Project + rope one block's q/k/v (shared by train and decode)."""
+              positions: jax.Array, n_heads: Optional[int] = None,
+              n_kv_heads: Optional[int] = None):
+    """Project + rope one block's q/k/v (shared by train, decode and the
+    tp-resident pipeline stage, which passes its LOCAL head counts —
+    column-sharded projections yield contiguous head blocks)."""
     B, T = h.shape[:2]
     dt = h.dtype
-    q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_kv_heads,
-                                             cfg.head_dim)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_kv_heads,
-                                             cfg.head_dim)
+    nh = cfg.n_heads if n_heads is None else n_heads
+    nkv = cfg.n_kv_heads if n_kv_heads is None else n_kv_heads
+    q = (h @ layer["wq"].astype(dt)).reshape(B, T, nh, cfg.head_dim)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, T, nkv, cfg.head_dim)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, T, nkv, cfg.head_dim)
     return (
         _rope(q, positions, cfg.rope_theta),
         _rope(k, positions, cfg.rope_theta),
@@ -243,13 +246,19 @@ def _attn_qkv(layer: Params, h: jax.Array, cfg: LlamaConfig,
     )
 
 
-def _mlp_block(layer: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """SwiGLU MLP sub-block with residual (shared by train and decode)."""
-    dt = x.dtype
-    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+def _swiglu(layer: Params, h: jax.Array) -> jax.Array:
+    """The SwiGLU core (no norm, no residual) — shared by the plain
+    block and the tp-resident stage (whose row-sharded ``w_down`` makes
+    this a PARTIAL sum completed by a psum)."""
+    dt = h.dtype
     gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
     up = h @ layer["w_up"].astype(dt)
-    return x + (gate * up) @ layer["w_down"].astype(dt)
+    return (gate * up) @ layer["w_down"].astype(dt)
+
+
+def _mlp_block(layer: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """SwiGLU MLP sub-block with residual (shared by train and decode)."""
+    return x + _swiglu(layer, _rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Params:
@@ -505,6 +514,69 @@ def pp_param_specs(cfg: LlamaConfig, axis: str = "pp") -> Params:
     }
 
 
+def _layer_apply_tp_local(
+    layer: Params,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    tp_axis: str,
+    n_tp: int,
+) -> jax.Array:
+    """One transformer block on LOCAL tensor-parallel weight shards
+    (Megatron layout, explicit collectives) — the tp-resident pipeline
+    stage body.  ``wq/wk/wv`` are column-sharded (each device computes
+    its ``n_heads/tp`` heads end-to-end), ``wo`` row-sharded (partial
+    residual contributions summed with ``psum``); ``w_gate/w_up``
+    column-sharded (``d_ff/tp`` hidden), ``w_down`` row-sharded
+    (``psum``).  Two psums per layer — the classic Megatron count —
+    riding ICI inside the pipeline's shard_map.
+    """
+    from jax import lax
+
+    from ddl_tpu.parallel.ring_attention import attention
+
+    B, T = x.shape[:2]
+    dt = x.dtype
+    lh = cfg.n_heads // n_tp  # local query heads
+    lkv = cfg.n_kv_heads // n_tp  # local KV heads
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    # The SAME projection/rope/SwiGLU helpers as the plain block — only
+    # the head counts and the two completing psums differ, so tp-resident
+    # numerics cannot drift from forward's.
+    q, k, v = _attn_qkv(
+        layer, h, cfg, positions, n_heads=lh, n_kv_heads=lkv
+    )
+    attn = attention(
+        q, k, v, mesh=None, impl=cfg.attn_impl, causal=True,
+        kv_repeat=lh // lkv,
+    )
+    # Row-sharded wo: each device's head block contributes a PARTIAL
+    # output projection; the psum completes the sum over heads.
+    x = x + lax.psum(
+        attn.reshape(B, T, -1) @ layer["wo"].astype(dt), tp_axis
+    )
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    return x + lax.psum(_swiglu(layer, h), tp_axis)
+
+
+#: Per-stage inner PartitionSpecs for tp-RESIDENT pipeline stages
+#: (leading per-stage layer axis unsharded; Megatron column/row layout
+#: on the weight dims).  Only ``tp`` appears: fsdp still gathers at the
+#: shard_map boundary (compute needs full d_model rows), it shards
+#: at-rest storage only.
+_TP_STAGE_SPECS = {
+    "attn_norm": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "mlp_norm": P(None, None),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+}
+
+
 def forward_pp(
     params: Params,
     tokens: jax.Array,
@@ -524,21 +596,46 @@ def forward_pp(
     unsupported here; use :func:`forward` for packed batches).
 
     Working-memory model (the honest cost account): each device holds
-    its OWN stage's weights in full for the whole step — fsdp/tp shard
-    the at-rest storage, but ``pipeline_apply`` gathers the trailing
-    axes at the shard_map boundary, so peak per-device weight memory is
-    ``params/S`` regardless of fsdp — plus one microbatch's activations
-    times the live scan depth.  At 8B/S=4 that is ~4 GiB bf16 weights
-    resident per device; pp is the axis that divides weight working
-    memory, fsdp divides only storage.
+    its own stage's weights for the whole step, plus one microbatch's
+    activations times the live scan depth.  With a ``tp`` axis in the
+    mesh (and head counts divisible by it), stages run TENSOR-PARALLEL
+    RESIDENT: weight shards stay local inside the shard_map and each
+    layer completes with two explicit psums over tp (Megatron), so peak
+    per-device weight memory is ``params/(S·tp)``.  Without tp it is
+    ``params/S`` — fsdp on the trailing axes shards at-rest STORAGE
+    only (compute needs full d_model rows, so it gathers at the
+    shard_map boundary once per step).  At 8B, S=4: ~4 GiB bf16
+    resident per device; S=4 × tp=4: ~1 GiB.
     """
     B, T = tokens.shape
     dt = cfg.dtype
     positions = jnp.arange(T)
     x = params["embed"].astype(dt)[tokens]
 
-    def one_layer(x: jax.Array, layer: Params) -> jax.Array:
-        return _layer_apply(layer, x, cfg, positions, mesh=None)
+    n_tp = (
+        mesh.shape["tp"]
+        if "tp" in mesh.axis_names
+        and axis in mesh.axis_names
+        and mesh.shape.get(axis, 1) > 1  # pp=1 takes the sequential
+        # fallback, which runs stage_fn outside shard_map where the
+        # tp psums cannot resolve
+        else 1
+    )
+    tp_resident = (
+        n_tp > 1
+        and cfg.n_heads % n_tp == 0
+        and cfg.n_kv_heads % n_tp == 0
+        and cfg.d_ff % n_tp == 0
+    )
+
+    if tp_resident:
+        def one_layer(x: jax.Array, layer: Params) -> jax.Array:
+            return _layer_apply_tp_local(
+                layer, x, cfg, positions, "tp", n_tp
+            )
+    else:
+        def one_layer(x: jax.Array, layer: Params) -> jax.Array:
+            return _layer_apply(layer, x, cfg, positions, mesh=None)
 
     layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
 
@@ -551,7 +648,8 @@ def forward_pp(
     from ddl_tpu.parallel.pipeline import pipeline_apply
 
     x = pipeline_apply(
-        params["stages"], x, stage_fn, mesh, n_microbatches, axis=axis
+        params["stages"], x, stage_fn, mesh, n_microbatches, axis=axis,
+        stage_param_specs=_TP_STAGE_SPECS if tp_resident else None,
     )
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)

@@ -79,7 +79,9 @@ def _pipeline_shard(params_local: Any, x: jax.Array, *, stage_fn, axis: str,
                     n_micro: int):
     """Per-device body (under shard_map over ``axis``).
 
-    params_local leaves have leading dim 1 (this device's stage); x is the
+    params_local leaves have leading dim 1 (this device's stage) and —
+    with ``stage_param_specs`` — trailing dims still sharded (the
+    stage_fn then owns the collectives over those axes); x is the
     full (M, mb, ...) microbatched input, replicated over ``axis``.
     """
     S = lax.psum(1, axis)
@@ -138,6 +140,7 @@ def pipeline_apply(
     n_microbatches: int,
     axis: str = "pp",
     batch_spec: "P | None" = None,
+    stage_param_specs: Any = None,
 ) -> jax.Array:
     """Apply S pipelined stages to a batch x (B, ...).
 
@@ -154,6 +157,16 @@ def pipeline_apply(
     axis that divides it (each pp group works on its own dp shard instead
     of replicating the whole batch, VERDICT r2 Weak #5); otherwise
     replicated.
+
+    ``stage_param_specs`` (a PartitionSpec pytree matching ONE stage's
+    params, without the leading S axis): keep those trailing axes
+    SHARDED inside the shard_map instead of gathering them at the
+    boundary — ``stage_fn`` then receives local shards and owns the
+    collectives over the named axes (e.g. Megatron tensor parallelism
+    with explicit ``lax.psum(.., "tp")`` at the block reduction points).
+    Per-device weight working memory drops from params/S to
+    params/(S·tp).  Default (None): trailing axes gather at the
+    boundary, ``stage_fn`` is a plain local function.
     """
     S = jax.tree.leaves(stacked_params)[0].shape[0]
     B = x.shape[0]
@@ -170,6 +183,13 @@ def pipeline_apply(
     xm = x.reshape((n_microbatches, mb) + x.shape[1:])
 
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        if stage_param_specs is not None:
+            raise ValueError(
+                "stage_param_specs (tensor-parallel-resident stages) "
+                f"requires a {axis!r} mesh axis: the sequential fallback "
+                "runs stage_fn outside shard_map, where its named-axis "
+                "collectives cannot resolve"
+            )
         out, _ = lax.scan(lambda h, p: (stage_fn(p, h), None),
                           x, stacked_params)
         return out
@@ -179,7 +199,14 @@ def pipeline_apply(
 
     from jax import shard_map
 
-    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    if stage_param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    else:
+        param_specs = jax.tree.map(
+            lambda s: P(axis, *tuple(s)),
+            stage_param_specs,
+            is_leaf=lambda v: isinstance(v, P),
+        )
     fn = shard_map(
         functools.partial(
             _pipeline_shard, stage_fn=stage_fn, axis=axis,
