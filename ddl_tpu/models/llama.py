@@ -475,22 +475,11 @@ def stage_params(params: Params, n_stages: int) -> Params:
     Inverse-free by design: training checkpoints save THIS layout; the
     non-pp layout is only an initialization convenience.
     """
-    L = len(params["layers"])
-    if n_stages < 1 or L % n_stages:
-        raise ValueError(
-            f"n_layers={L} must divide into n_stages={n_stages}"
-        )
-    per = L // n_stages
-    stages = [
-        jax.tree.map(
-            lambda *xs: jnp.stack(xs),
-            *params["layers"][s * per : (s + 1) * per],
-        )
-        for s in range(n_stages)
-    ]
+    from ddl_tpu.parallel.pipeline import stack_layer_stages
+
     return {
         "embed": params["embed"],
-        "stages": jax.tree.map(lambda *xs: jnp.stack(xs), *stages),
+        "stages": stack_layer_stages(params["layers"], n_stages),
         "final_norm": params["final_norm"],
         "lm_head": params["lm_head"],
     }
@@ -501,14 +490,11 @@ def pp_param_specs(cfg: LlamaConfig, axis: str = "pp") -> Params:
     the stage axis (at-rest storage is one stage per pp group), the
     per-stage layer axis is unsharded, and the trailing axes keep the
     Megatron fsdp/tp layout of :func:`param_specs`."""
-    layer = param_specs(cfg)["layers"][0]
+    from ddl_tpu.parallel.pipeline import stage_spec_tree
+
     return {
         "embed": P(None, "fsdp"),
-        "stages": jax.tree.map(
-            lambda s: P(axis, None, *tuple(s)),
-            layer,
-            is_leaf=lambda x: isinstance(x, P),
-        ),
+        "stages": stage_spec_tree(param_specs(cfg)["layers"][0], axis),
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }

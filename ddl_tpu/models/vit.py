@@ -129,6 +129,55 @@ def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
     return x.reshape(B, g * g, cfg.patch_dim)
 
 
+def _layer_apply(
+    layer: Params,
+    x: jax.Array,
+    cfg: ViTConfig,
+    mesh: Optional[Any] = None,
+) -> jax.Array:
+    """One encoder block on the residual stream — shared by
+    :func:`forward` and the pipelined :func:`forward_pp` (one body, so
+    the two paths cannot diverge)."""
+    from ddl_tpu.parallel.ring_attention import attention
+
+    B, T = x.shape[:2]
+    dt = x.dtype
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads,
+                                             cfg.head_dim)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_heads,
+                                             cfg.head_dim)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_heads,
+                                             cfg.head_dim)
+    attn = attention(
+        q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=False
+    )
+    x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    return x + jax.nn.gelu(h @ layer["w_up"].astype(dt)) @ layer[
+        "w_down"
+    ].astype(dt)
+
+
+def _embed(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """Patchify + project + position-embed (shared by both forwards)."""
+    dt = cfg.dtype
+    if images.ndim == 2:  # the loader's flattened pixel rows
+        images = images.reshape(
+            -1, cfg.image_size, cfg.image_size, cfg.n_channels
+        )
+    x = patchify(images.astype(dt), cfg) @ params["patch_embed"].astype(dt)
+    return x + params["pos_embed"].astype(dt)[None]
+
+
+def _head(params: Params, x: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """Final norm + mean pool + classification head (shared)."""
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    pooled = jnp.mean(x.astype(jnp.float32), axis=1)  # (B, d)
+    return pooled @ params["head"]
+
+
 def forward(
     params: Params,
     images: jax.Array,
@@ -137,39 +186,87 @@ def forward(
 ) -> jax.Array:
     """Class logits (B, n_classes); images (B, H, W, C) or flat
     (B, H*W*C)."""
-    from ddl_tpu.parallel.ring_attention import attention
-
-    dt = cfg.dtype
-    if images.ndim == 2:  # the loader's flattened pixel rows
-        images = images.reshape(
-            -1, cfg.image_size, cfg.image_size, cfg.n_channels
-        )
-    B = images.shape[0]
-    x = patchify(images.astype(dt), cfg) @ params["patch_embed"].astype(dt)
-    x = x + params["pos_embed"].astype(dt)[None]
-
-    T = cfg.n_patches
+    x = _embed(params, images, cfg)
     for layer in params["layers"]:
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, T, cfg.n_heads,
-                                                 cfg.head_dim)
-        k = (h @ layer["wk"].astype(dt)).reshape(B, T, cfg.n_heads,
-                                                 cfg.head_dim)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, T, cfg.n_heads,
-                                                 cfg.head_dim)
-        attn = attention(
-            q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=False
+        x = _layer_apply(layer, x, cfg, mesh=mesh)
+    return _head(params, x, cfg)
+
+
+# -- pipeline parallelism ----------------------------------------------------
+
+
+def stage_params(params: Params, n_stages: int) -> Params:
+    """Regroup an :func:`init_params` pytree for pipeline parallelism —
+    the same ``(S, L/S)`` stage layout as
+    ``models.llama.stage_params`` (shared
+    ``parallel.pipeline.stack_layer_stages``); embed and head stay
+    outside the pipe."""
+    from ddl_tpu.parallel.pipeline import stack_layer_stages
+
+    return {
+        "patch_embed": params["patch_embed"],
+        "pos_embed": params["pos_embed"],
+        "stages": stack_layer_stages(params["layers"], n_stages),
+        "final_norm": params["final_norm"],
+        "head": params["head"],
+    }
+
+
+def pp_param_specs(cfg: ViTConfig, axis: str = "pp") -> Params:
+    """PartitionSpecs for the :func:`stage_params` layout."""
+    from ddl_tpu.parallel.pipeline import stage_spec_tree
+
+    return {
+        "patch_embed": P(None, "fsdp"),
+        "pos_embed": P(None, "fsdp"),
+        "stages": stage_spec_tree(param_specs(cfg)["layers"][0], axis),
+        "final_norm": P(None),
+        "head": P("fsdp", None),
+    }
+
+
+def forward_pp(
+    params: Params,
+    images: jax.Array,
+    cfg: ViTConfig,
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Class logits with the encoder blocks pipelined over ``axis``
+    (GPipe schedule) — the image-family twin of
+    ``models.llama.forward_pp``; attention inside a stage is
+    single-device."""
+    from ddl_tpu.parallel.pipeline import pipeline_apply
+
+    x = _embed(params, images, cfg)
+
+    def stage_fn(stage: Params, h: jax.Array) -> jax.Array:
+        out, _ = jax.lax.scan(
+            lambda c, lyr: (_layer_apply(lyr, c, cfg), None), h, stage
         )
-        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+        return out
 
-        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + jax.nn.gelu(h @ layer["w_up"].astype(dt)) @ layer[
-            "w_down"
-        ].astype(dt)
+    x = pipeline_apply(
+        params["stages"], x, stage_fn, mesh, n_microbatches, axis=axis
+    )
+    return _head(params, x, cfg)
 
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    pooled = jnp.mean(x.astype(jnp.float32), axis=1)  # (B, d)
-    return pooled @ params["head"]
+
+def classification_loss_pp(
+    params: Params,
+    batch: Any,
+    cfg: ViTConfig,
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """:func:`classification_loss` over the pipelined forward."""
+    from ddl_tpu.models.losses import cross_entropy
+
+    pixels, labels = batch[0], batch[1]
+    logits = forward_pp(params, pixels, cfg, mesh, n_microbatches, axis=axis)
+    return cross_entropy(logits, labels.reshape(-1))
 
 
 def classification_loss(

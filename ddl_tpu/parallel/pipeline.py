@@ -63,6 +63,42 @@ def stack_stage_params(per_stage: list) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
+def stack_layer_stages(layers: list, n_stages: int) -> Any:
+    """Regroup a model's per-layer param list into ``n_stages`` equal
+    stages stacked as ``(S, L/S, ...)`` leaves — the layout
+    :func:`pipeline_apply` schedules, with each stage's ``stage_fn``
+    scanning its own ``L/S`` layers.  Shared by every uniform-block
+    family (llama, vit): one regrouping implementation, not one per
+    model."""
+    L = len(layers)
+    if n_stages < 1 or L % n_stages:
+        raise ValueError(
+            f"n_layers={L} must divide into n_stages={n_stages}"
+        )
+    per = L // n_stages
+    # The (S, L/S) layout IS two applications of stack_stage_params:
+    # layers stack within each stage, then stages stack on top.
+    return stack_stage_params(
+        [
+            stack_stage_params(layers[s * per : (s + 1) * per])
+            for s in range(n_stages)
+        ]
+    )
+
+
+def stage_spec_tree(layer_spec: Any, axis: str = "pp") -> Any:
+    """PartitionSpecs for a :func:`stack_layer_stages` stage tree: the
+    ``pp`` axis shards stages, the per-stage layer axis is unsharded,
+    trailing axes keep the model's per-layer layout.  The spec-side
+    twin of :func:`stack_layer_stages` — one transform, not one per
+    model family."""
+    return jax.tree.map(
+        lambda s: P(axis, None, *tuple(s)),
+        layer_spec,
+        is_leaf=lambda v: isinstance(v, P),
+    )
+
+
 def pipeline_spec(inner_spec_tree: Any, axis: str = "pp") -> Any:
     """Prepend the pipeline axis to every leaf spec of a stage param tree.
 

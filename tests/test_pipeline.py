@@ -255,6 +255,63 @@ class TestLlamaPipeline:
         )
 
 
+class TestViTPipeline:
+    """The image family through the pipe: same stage layout and schedule
+    as llama (shared stack_layer_stages), non-causal attention."""
+
+    def _cfg(self):
+        from ddl_tpu.models.vit import ViTConfig
+
+        return ViTConfig(
+            image_size=16, patch_size=4, d_model=32, n_layers=4,
+            n_heads=4, d_ff=64, n_classes=8, dtype=jnp.float32,
+            attn_impl="dense",
+        )
+
+    def test_forward_pp_matches_forward(self, rng):
+        from ddl_tpu.models import vit
+
+        cfg = self._cfg()
+        params = vit.init_params(cfg, jax.random.key(0))
+        images = jnp.asarray(
+            rng.random((8, 16 * 16 * 3)), jnp.float32
+        )
+        ref = np.asarray(vit.forward(params, images, cfg))
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        got = vit.forward_pp(
+            vit.stage_params(params, 4), images, cfg, mesh,
+            n_microbatches=4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), ref, atol=2e-5, rtol=2e-5
+        )
+
+    def test_train_step_pp_vit(self, rng):
+        from ddl_tpu.models import vit
+
+        cfg = self._cfg()
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        init_fn, step_fn = make_train_step(
+            lambda p, b: vit.classification_loss_pp(
+                p, b, cfg, mesh, n_microbatches=4
+            ),
+            optax.adam(1e-2), mesh, vit.pp_param_specs(cfg),
+            batch_spec=P(("dp",)),
+        )
+        state = init_fn(
+            vit.stage_params(vit.init_params(cfg, jax.random.key(0)), 4)
+        )
+        g = np.random.default_rng(0)
+        pixels = g.random((8, 16 * 16 * 3)).astype(np.float32)
+        labels = g.integers(0, 8, (8, 1)).astype(np.float32)
+        losses = []
+        for _ in range(8):
+            state, loss = step_fn(state, (pixels, labels))
+            losses.append(float(loss))
+        assert abs(losses[0] - np.log(8)) < 0.5, losses[0]
+        assert losses[-1] < losses[0] - 0.3, losses
+
+
 def test_pipeline_gradients_train(rng):
     """A pipelined regression model trains end-to-end on a pp×dp mesh —
     grads flow backwards through the ppermute schedule."""
