@@ -50,6 +50,15 @@ def test_train_llama_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_llama_pp_example(tmp_path):
+    """Pipeline-parallel training example: staged llama, window-streamed
+    loader, loss decreases — and the tp-resident layout runs too."""
+    out = _run("train_llama_pp.py", "pp_tp")
+    assert "OK" in out
+    assert "'tp': 2" in out
+
+
+@pytest.mark.slow
 def test_train_vit_example(tmp_path):
     out = _run("train_vit.py")
     assert "PASS" in out
