@@ -98,6 +98,23 @@ class TestThreadModeE2E:
         tags = main()
         assert tags == [1, 2, 3, 1, 2, 3]
 
+    def test_fewer_epochs_than_producers_exits_clean(self):
+        """The reference's unhandled 'epochs < workers' ToDo (Q6, its
+        mpi_dataloader.py:19): producers whose windows are never served
+        must not strand the run — shutdown reaches their blocked fill
+        waits and the decorated main returns."""
+
+        @distributed_dataloader(n_producers=3, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedProducer(n_data=16), batch_size=16,
+                connection=env.connection, n_epochs=1, output="numpy",
+            )
+            return drain(loader, 1)
+
+        seen = main()  # returning AT ALL is the assertion (no deadlock)
+        assert len(seen) == 1
+
     def test_single_producer_single_slot(self):
         """nslots=1 = reference-style strict alternation; still drains."""
 
