@@ -110,10 +110,17 @@ class TestThreadModeE2E:
                 TaggedProducer(n_data=16), batch_size=16,
                 connection=env.connection, n_epochs=1, output="numpy",
             )
-            return drain(loader, 1)
+            return drain(loader, 1), env.workers.threads
 
-        seen = main()  # returning AT ALL is the assertion (no deadlock)
+        seen, threads = main()
         assert len(seen) == 1
+        # The decorator's teardown join() gives up on still-alive daemon
+        # threads after a timeout without raising — so assert the
+        # producers actually DIED, or a stranded-producer regression
+        # would pass this test silently.
+        for t in threads:
+            t.join(5)
+            assert not t.is_alive(), f"{t.name} stranded after shutdown"
 
     def test_single_producer_single_slot(self):
         """nslots=1 = reference-style strict alternation; still drains."""
