@@ -191,6 +191,35 @@ def moe_mlp(
     return out, aux
 
 
+def _layer_apply(
+    layer: Params,
+    x: jax.Array,
+    cfg: MoeConfig,
+    positions: jax.Array,
+    mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One MoE block on the residual stream → (x, router aux) — the
+    single layer body shared by :func:`forward` and the pipelined
+    :func:`forward_pp` (same math, so pp/non-pp cannot diverge)."""
+    from ddl_tpu.parallel.ring_attention import attention
+
+    B, T = x.shape[:2]
+    dt = x.dtype
+    h = _llama._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q, kk, v = _llama._attn_qkv(layer, h, cfg, positions)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    attn = attention(
+        q, kk, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
+        kv_repeat=rep, segment_ids=segment_ids,
+    )
+    x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+
+    h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    moe_out, aux = moe_mlp(h.reshape(B * T, -1), layer, cfg)
+    return x + moe_out.reshape(B, T, -1), aux
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -202,27 +231,15 @@ def forward(
 
     ``segment_ids`` (B, T): packed-batch attention masking, as in
     ``models.llama.forward``."""
-    from ddl_tpu.parallel.ring_attention import attention
-
-    B, T = tokens.shape
     dt = cfg.dtype
-    positions = jnp.arange(T)
+    positions = jnp.arange(tokens.shape[1])
     x = params["embed"].astype(dt)[tokens]
     aux_total = jnp.zeros((), jnp.float32)
 
     def layer_fn(x: jax.Array, layer: Params):
-        h = _llama._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q, kk, v = _llama._attn_qkv(layer, h, cfg, positions)
-        rep = cfg.n_heads // cfg.n_kv_heads
-        attn = attention(
-            q, kk, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
-            kv_repeat=rep, segment_ids=segment_ids,
+        return _layer_apply(
+            layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
         )
-        x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
-
-        h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        moe_out, aux = moe_mlp(h.reshape(B * T, -1), layer, cfg)
-        return x + moe_out.reshape(B, T, -1), aux
 
     if cfg.remat:
         # Save only each layer's residual-stream input; recompute the
@@ -236,6 +253,115 @@ def forward(
     x = _llama._rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, aux_total / cfg.n_layers
+
+
+# -- pipeline parallelism ----------------------------------------------------
+
+
+def stage_params(params: Params, n_stages: int) -> Params:
+    """Regroup an :func:`init_params` pytree for pipeline parallelism —
+    the shared ``(S, L/S)`` stage layout
+    (``parallel.pipeline.stack_layer_stages``); embed and head stay
+    outside the pipe.  Expert stacks keep their leading E axis inside
+    each stage leaf: ``(S, L/S, E, ...)``."""
+    from ddl_tpu.parallel.pipeline import stack_layer_stages
+
+    return {
+        "embed": params["embed"],
+        "stages": stack_layer_stages(params["layers"], n_stages),
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def pp_param_specs(cfg: MoeConfig, axis: str = "pp") -> Params:
+    """PartitionSpecs for the :func:`stage_params` layout — ``pp``
+    shards stages; within a stage the expert/Megatron layout of
+    :func:`param_specs` applies (``ep`` still shards the expert axis of
+    the at-rest storage)."""
+    from ddl_tpu.parallel.pipeline import stage_spec_tree
+
+    return {
+        "embed": P(None, "fsdp"),
+        "stages": stage_spec_tree(param_specs(cfg)["layers"][0], axis),
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def forward_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+) -> Tuple[jax.Array, jax.Array]:
+    """(logits, mean router aux loss) with the MoE blocks pipelined over
+    ``axis`` (GPipe schedule).
+
+    The router aux loss accumulates THROUGH the pipe: the activation
+    pytree carries a per-row accumulator alongside the residual stream
+    (``pipeline_apply`` hops every leaf together), each stage adds its
+    layers' aux, and the caller averages over rows.  Capacity
+    semantics: routing groups are the token sets ``moe_mlp`` sees —
+    one dp shard of one microbatch under the auto dp batch spec
+    (``C = ceil(topk·(mb/dp)·T/E·cf)``), the whole microbatch when dp
+    does not shard it.  Logits match the non-pp forward exactly
+    whenever capacity does not bind (routing is per-token); the aux is
+    the mean of per-group aux — the same load-balance pressure at
+    group granularity, not numerically equal to the full-batch aux
+    (it is not linear in token subsets).
+    """
+    B, T = tokens.shape
+    dt = cfg.dtype
+    positions = jnp.arange(T)
+    x = params["embed"].astype(dt)[tokens]
+
+    def one_layer(state, layer):
+        h, aux_rows = state
+        h, aux = _layer_apply(layer, h, cfg, positions, mesh=None)
+        return h, aux_rows + aux.astype(aux_rows.dtype)
+
+    layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
+
+    def stage_fn(stage: Params, state: Any) -> Any:
+        out, _ = jax.lax.scan(
+            lambda c, lyr: (layer_fn(c, lyr), None), state, stage
+        )
+        return out
+
+    from ddl_tpu.parallel.pipeline import pipeline_apply
+
+    x, aux_rows = pipeline_apply(
+        params["stages"],
+        (x, jnp.zeros((B,), jnp.float32)),
+        stage_fn, mesh, n_microbatches, axis=axis,
+    )
+    x = _llama._rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    # Every row of a microbatch carries that microbatch's summed aux;
+    # the row-mean is the microbatch-mean, normalized per layer as in
+    # the non-pp forward.
+    return logits, jnp.mean(aux_rows) / cfg.n_layers
+
+
+def next_token_loss_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    mesh: Any,
+    n_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Cross-entropy + weighted router aux over the pipelined forward."""
+    from ddl_tpu.models.losses import next_token_cross_entropy
+
+    logits, aux = forward_pp(
+        params, tokens, cfg, mesh, n_microbatches, axis=axis
+    )
+    ce = next_token_cross_entropy(logits, tokens)
+    return ce + cfg.router_aux_weight * aux
 
 
 # -- inference: KV-cache decode + generate -----------------------------------

@@ -111,14 +111,15 @@ def pipeline_spec(inner_spec_tree: Any, axis: str = "pp") -> Any:
     )
 
 
-def _pipeline_shard(params_local: Any, x: jax.Array, *, stage_fn, axis: str,
+def _pipeline_shard(params_local: Any, x: Any, *, stage_fn, axis: str,
                     n_micro: int):
     """Per-device body (under shard_map over ``axis``).
 
     params_local leaves have leading dim 1 (this device's stage) and —
     with ``stage_param_specs`` — trailing dims still sharded (the
     stage_fn then owns the collectives over those axes); x is the
-    full (M, mb, ...) microbatched input, replicated over ``axis``.
+    full (M, mb, ...) microbatched activation PYTREE (a bare array in
+    the common case), replicated over ``axis``.
     """
     S = lax.psum(1, axis)
     my_stage = lax.axis_index(axis)
@@ -128,8 +129,10 @@ def _pipeline_shard(params_local: Any, x: jax.Array, *, stage_fn, axis: str,
     def tick(carry, t):
         buf, outputs = carry
         # Stage 0 ingests microbatch t (clamped once the pipe is draining).
-        feed = x[jnp.minimum(t, n_micro - 1)]
-        inp = jnp.where(my_stage == 0, feed, buf)
+        feed = jax.tree.map(lambda a: a[jnp.minimum(t, n_micro - 1)], x)
+        inp = jax.tree.map(
+            lambda f, b: jnp.where(my_stage == 0, f, b), feed, buf
+        )
         # Stage s holds real data only for ticks s <= t < s + M — outside
         # that window (pipe filling/draining) the buffer is garbage, and
         # running stage_fn on it was pure bubble FLOPs (VERDICT r2 Weak
@@ -140,7 +143,7 @@ def _pipeline_shard(params_local: Any, x: jax.Array, *, stage_fn, axis: str,
         y = lax.cond(
             live,
             lambda a: stage_fn(params_my, a),
-            lambda a: jnp.zeros_like(a),
+            lambda a: jax.tree.map(jnp.zeros_like, a),
             inp,
         )
         # Last stage emits microbatch t-S+1 once the pipe is full.
@@ -148,42 +151,56 @@ def _pipeline_shard(params_local: Any, x: jax.Array, *, stage_fn, axis: str,
         valid = (my_stage == S - 1) & (out_idx >= 0)
         outputs = lax.cond(
             valid,
-            lambda o: lax.dynamic_update_index_in_dim(
-                o, y, jnp.maximum(out_idx, 0), 0
+            lambda o: jax.tree.map(
+                lambda acc, v: lax.dynamic_update_index_in_dim(
+                    acc, v, jnp.maximum(out_idx, 0), 0
+                ),
+                o, y,
             ),
             lambda o: o,
             outputs,
         )
-        buf = lax.ppermute(y, axis, fwd_perm)
+        buf = jax.tree.map(
+            lambda v: lax.ppermute(v, axis, fwd_perm), y
+        )
         return (buf, outputs), None
 
-    buf0 = jnp.zeros_like(x[0])
-    out0 = jnp.zeros((n_micro,) + x.shape[1:], x.dtype)
+    buf0 = jax.tree.map(lambda a: jnp.zeros_like(a[0]), x)
+    out0 = jax.tree.map(
+        lambda a: jnp.zeros((n_micro,) + a.shape[1:], a.dtype), x
+    )
     (_, outputs), _ = lax.scan(
         tick, (buf0, out0), jnp.arange(n_micro + S - 1)
     )
     # Outputs are populated only on the last stage; psum broadcasts them.
-    return lax.psum(
-        jnp.where(my_stage == S - 1, outputs, jnp.zeros_like(outputs)), axis
+    return jax.tree.map(
+        lambda o: lax.psum(
+            jnp.where(my_stage == S - 1, o, jnp.zeros_like(o)), axis
+        ),
+        outputs,
     )
 
 
 def pipeline_apply(
     stacked_params: Any,
-    x: jax.Array,
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    x: Any,
+    stage_fn: Callable[[Any, Any], Any],
     mesh: Any,
     n_microbatches: int,
     axis: str = "pp",
     batch_spec: "P | None" = None,
     stage_param_specs: Any = None,
-) -> jax.Array:
+) -> Any:
     """Apply S pipelined stages to a batch x (B, ...).
 
     - ``stacked_params``: stage params stacked on a leading S axis (see
       :func:`stack_stage_params`), sharded ``P(axis, ...)``.
-    - ``stage_fn(stage_params, x) -> y`` with y.shape == x.shape (uniform
-      inter-stage activations, the usual transformer-block case).
+    - ``stage_fn(stage_params, x) -> y`` with y structurally identical
+      to x (uniform inter-stage activations, the usual transformer-block
+      case).  ``x`` may be a PYTREE whose leaves share the leading batch
+      axis — stages can then carry side state with the activation (e.g.
+      a per-row router-aux accumulator riding the MoE residual stream);
+      every leaf hops the ``ppermute`` together.
     - Falls back to a sequential scan over stages when the mesh has no
       ``axis`` (or size 1) — same math, no pipelining.
 
@@ -205,7 +222,7 @@ def pipeline_apply(
     boundary, ``stage_fn`` is a plain local function.
     """
     S = jax.tree.leaves(stacked_params)[0].shape[0]
-    B = x.shape[0]
+    B = jax.tree.leaves(x)[0].shape[0]
     assert B % n_microbatches == 0, (B, n_microbatches)
     mb = B // n_microbatches
     if batch_spec is None:
@@ -216,7 +233,9 @@ def pipeline_apply(
             and mb % mesh.shape["dp"] == 0
             else P()
         )
-    xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+    xm = jax.tree.map(
+        lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), x
+    )
 
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         if stage_param_specs is not None:
@@ -226,9 +245,22 @@ def pipeline_apply(
                 "runs stage_fn outside shard_map, where its named-axis "
                 "collectives cannot resolve"
             )
-        out, _ = lax.scan(lambda h, p: (stage_fn(p, h), None),
-                          x, stacked_params)
-        return out
+
+        # Per-MICROBATCH like the pipelined path — for per-row stage
+        # functions this is identical to one full-batch pass, but
+        # batch-coupled stages (MoE routing capacity/slot competition)
+        # must see the same token groups on every mesh shape, or runs
+        # would not be comparable between a pp mesh and the fallback.
+        def run_stages(state):
+            out, _ = lax.scan(
+                lambda h, p: (stage_fn(p, h), None), state, stacked_params
+            )
+            return out
+
+        out = lax.map(run_stages, xm)
+        return jax.tree.map(
+            lambda o, orig: o.reshape(orig.shape), out, x
+        )
     assert mesh.shape[axis] == S, (
         f"stacked params have {S} stages but mesh {axis}={mesh.shape[axis]}"
     )
@@ -243,15 +275,20 @@ def pipeline_apply(
             stage_param_specs,
             is_leaf=lambda v: isinstance(v, P),
         )
+    # One batch spec serves every activation leaf (they share the
+    # (M, mb) leading axes; a P names only leading dims).
+    batch_specs = jax.tree.map(lambda _: batch_spec, x)
     fn = shard_map(
         functools.partial(
             _pipeline_shard, stage_fn=stage_fn, axis=axis,
             n_micro=n_microbatches,
         ),
         mesh=mesh,
-        in_specs=(param_specs, batch_spec),
-        out_specs=batch_spec,
+        in_specs=(param_specs, batch_specs),
+        out_specs=batch_specs,
         check_vma=False,
     )
     out = fn(stacked_params, xm)
-    return out.reshape(x.shape)
+    return jax.tree.map(
+        lambda o, orig: o.reshape(orig.shape), out, x
+    )
