@@ -175,15 +175,10 @@ class DataPusher:
                             "method); this one does not advertise "
                             "supports_elastic_replay / rejoin",
                         )
-                    if nslots < 2:
-                        raise DoesNotMatchError(
-                            nslots,
-                            "elastic respawn with global shuffle needs "
-                            "nslots >= 2: with one slot the last "
-                            "committed window shares the slot the "
-                            "predecessor was filling when it died, so "
-                            "the state restore could read a torn fill",
-                        )
+                    # (The matching nslots >= 2 torn-fill guard runs
+                    # after ring attach, against the ATTACHED ring's
+                    # real geometry — the ctor arg may disagree with
+                    # what the predecessor created.)
                 # Fail LOUDLY at handshake when the shuffler's fabric
                 # declares a span too narrow to reach its exchange
                 # partners, instead of every producer stalling against a
@@ -220,6 +215,20 @@ class DataPusher:
 
         if rejoin_ring is not None:
             self.ring = connection.attach_ring(rejoin_ring)
+            if self.shuffler is not None and self.ring.nslots < 2:
+                # Checked against the ATTACHED ring's REAL geometry (the
+                # ctor arg may disagree with what the predecessor
+                # created): with one slot the last committed window
+                # shares the slot the predecessor was filling when it
+                # died, so the state restore could read a torn fill.
+                raise DoesNotMatchError(
+                    self.ring.nslots,
+                    "elastic respawn with global shuffle needs "
+                    "nslots >= 2: with one slot the last committed "
+                    "window shares the slot the predecessor was "
+                    "filling when it died, so the state restore could "
+                    "read a torn fill",
+                )
         else:
             self.ring = connection.create_ring(nslots, self.window_nbytes)
         if self.inplace_fill:

@@ -198,17 +198,19 @@ def forward(
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
 
 
-def _layer_apply(
+def _attn_block(
     layer: Params,
     x: jax.Array,
-    cfg: LlamaConfig,
+    cfg: Any,
     positions: jax.Array,
     mesh: Optional[Any] = None,
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """One transformer block on the residual stream — the single layer
-    body shared by :func:`forward` and the pipeline-parallel
-    :func:`forward_pp` (same math, so pp/non-pp cannot diverge)."""
+    """Attention sub-block (norm → qkv/rope → attention → wo residual)
+    on the residual stream — the train-side twin of
+    :func:`_attn_with_cache`, shared by the llama AND moe blocks (only
+    the MLP that follows differs, so attention semantics cannot drift
+    between families)."""
     from ddl_tpu.parallel.ring_attention import attention
 
     B, T = x.shape[:2]
@@ -222,7 +224,23 @@ def _layer_apply(
         q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
         kv_repeat=rep, segment_ids=segment_ids,
     )
-    x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+    return x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
+
+
+def _layer_apply(
+    layer: Params,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    mesh: Optional[Any] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """One transformer block on the residual stream — the single layer
+    body shared by :func:`forward` and the pipeline-parallel
+    :func:`forward_pp` (same math, so pp/non-pp cannot diverge)."""
+    x = _attn_block(
+        layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
+    )
     return _mlp_block(layer, x, cfg)
 
 

@@ -201,20 +201,13 @@ def _layer_apply(
 ) -> Tuple[jax.Array, jax.Array]:
     """One MoE block on the residual stream → (x, router aux) — the
     single layer body shared by :func:`forward` and the pipelined
-    :func:`forward_pp` (same math, so pp/non-pp cannot diverge)."""
-    from ddl_tpu.parallel.ring_attention import attention
-
+    :func:`forward_pp`.  The attention sub-block is llama's
+    ``_attn_block`` (one implementation across families); only the MLP
+    differs — routed experts instead of SwiGLU."""
     B, T = x.shape[:2]
-    dt = x.dtype
-    h = _llama._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q, kk, v = _llama._attn_qkv(layer, h, cfg, positions)
-    rep = cfg.n_heads // cfg.n_kv_heads
-    attn = attention(
-        q, kk, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
-        kv_repeat=rep, segment_ids=segment_ids,
+    x = _llama._attn_block(
+        layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
     )
-    x = x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
-
     h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     moe_out, aux = moe_mlp(h.reshape(B * T, -1), layer, cfg)
     return x + moe_out.reshape(B, T, -1), aux

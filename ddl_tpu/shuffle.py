@@ -476,7 +476,8 @@ class ThreadExchangeShuffler:
         ):
             put_key = (self.producer_idx, t, dest)
             self._rdv.put(put_key, my_ary[lane].copy())
-            self._sent.append((self._round, put_key))
+            if n == 2:  # the sweep only runs (and is only safe) at n == 2
+                self._sent.append((self._round, put_key))
             try:
                 my_ary[lane] = self._rdv.take(
                     (self.producer_idx, t, me), should_abort=should_abort
