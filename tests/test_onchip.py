@@ -264,6 +264,59 @@ class TestWindowStreamOnChip:
             1003.0, 2003.0, 1004.0, 2004.0,
         ], tags
 
+    def test_mixed_window_sizes_stream_on_chip(self):
+        """Weighted rotation through the REAL zero-copy transfer path
+        (round-5 loader change): producers with unequal
+        batches_per_window stream differently-shaped windows whose
+        content survives the slot→HBM hop intact."""
+        from ddl_tpu import (
+            DataProducerOnInitReturn,
+            DistributedDataLoader,
+            Marker,
+            ProducerFunctionSkeleton,
+            distributed_dataloader,
+        )
+
+        class MixedTagged(ProducerFunctionSkeleton):
+            inplace_fill = True
+
+            def on_init(self, producer_idx=0, **kw):
+                self.idx = producer_idx
+                self.it = 0
+                rows = 512 if producer_idx == 1 else 1024
+                return DataProducerOnInitReturn(
+                    nData=rows, nValues=256, shape=(rows, 256),
+                    splits=(255, 1),
+                )
+
+            def post_init(self, my_ary, **kw):
+                my_ary[:] = self.idx * 1000
+
+            def execute_function(self, my_ary, **kw):
+                self.it += 1
+                my_ary[:] = self.idx * 1000 + self.it
+
+        @distributed_dataloader(n_producers=2, mode="thread", nslots=2)
+        def main(env):
+            loader = DistributedDataLoader(
+                MixedTagged(), batch_size=256, connection=env.connection,
+                n_epochs=6, output="jax",
+            )
+            got = []
+            for win in loader.windows():
+                vals = np.unique(np.asarray(win))
+                assert len(vals) == 1, f"torn window: {vals[:8]}"
+                got.append((tuple(win.shape), float(vals[0])))
+                loader.mark(Marker.END_OF_EPOCH)
+            return got
+
+        got = main()
+        assert got == [
+            ((2, 256, 256), 1001.0), ((4, 256, 256), 2001.0),
+            ((2, 256, 256), 1002.0), ((4, 256, 256), 2002.0),
+            ((2, 256, 256), 1003.0), ((4, 256, 256), 2003.0),
+        ], got
+
     def test_trainer_window_stream_on_chip(self):
         """window_stream fit on the real chip: one transfer + one scanned
         multistep per window, finite decreasing loss."""
