@@ -117,6 +117,37 @@ def _gate_utilization(ns: dict, label: str) -> dict:
     return ns
 
 
+def pin_platform(default_timeout_s: float = 300.0) -> str:
+    """THE platform bring-up for bench and every probe tool: probe the
+    backend in a killable subprocess (:func:`_probe_backend`), and when
+    it is not a TPU, pin the CPU fallback BEFORE the caller's first
+    in-process device touch — the axon sitecustomize re-exports
+    ``JAX_PLATFORMS`` at interpreter start, so only the live config pin
+    sticks, and an unpinned touch on a hung tunnel hangs the process.
+    ``DDL_BENCH_PROBE_TIMEOUT_S`` overrides the probe deadline.  Returns
+    the platform; the CPU fallback is announced on stderr so a
+    slow-but-healthy attach that timed out cannot silently publish CPU
+    numbers as device measurements.
+    """
+    platform = _probe_backend(
+        float(
+            os.environ.get(
+                "DDL_BENCH_PROBE_TIMEOUT_S", str(default_timeout_s)
+            )
+        )
+    )
+    if platform != "tpu":
+        os.environ["JAX_PLATFORMS"] = platform
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        print(
+            f"bench: TPU backend unavailable; pinned platform={platform}",
+            file=sys.stderr,
+        )
+    return platform
+
+
 def _probe_backend(timeout_s: float) -> str:
     """Decide the JAX platform WITHOUT importing jax in this process.
 
@@ -707,20 +738,9 @@ def _attn_sweep(seqs=(2048, 4096, 8192)):
 def main() -> None:
     t_start = time.perf_counter()
     mode = os.environ.get("DDL_BENCH_MODE", "all")
-    probe_timeout = float(os.environ.get("DDL_BENCH_PROBE_TIMEOUT_S", "300"))
     errors: dict = {}
 
-    platform = _probe_backend(probe_timeout)
-    if platform != "tpu":
-        # Pin it so in-process jax import cannot retry (and hang on) the
-        # broken accelerator path the probe just rejected.  The env var is
-        # NOT enough under the axon plugin (its sitecustomize re-exports
-        # JAX_PLATFORMS=axon at interpreter start), so pin the live config
-        # too — this is what tests/conftest.py does.
-        os.environ["JAX_PLATFORMS"] = platform
-        import jax
-
-        jax.config.update("jax_platforms", platform)
+    platform = pin_platform()
 
     result: dict = {
         "metric": "ingest_samples_per_sec",

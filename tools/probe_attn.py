@@ -21,6 +21,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import bench as _bench  # noqa: E402
+
+_bench.pin_platform()  # killable probe + CPU pin on a down tunnel —
+# MUST run before the jax import below touches any device.
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 

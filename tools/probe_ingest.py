@@ -27,6 +27,9 @@ def best(n, fn):
 
 
 def main():
+    import bench
+
+    bench.pin_platform()  # killable probe + CPU pin on a down tunnel
     import jax
     import jax.numpy as jnp
 

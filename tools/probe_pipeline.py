@@ -67,6 +67,7 @@ def run(mode, output, compute, use_prefetch, n_producers=2, nslots=2):
 
 
 def main():
+    bench.pin_platform()  # killable probe + CPU pin on a down tunnel
     mode = sys.argv[1] if len(sys.argv) > 1 else "thread"
     out = {"mode": mode}
     out["numpy_nocompute"] = run(mode, "numpy", False, False)

@@ -49,20 +49,9 @@ def _rate(nbytes: int, fn, reps: int) -> float:
 
 
 def main(window_mib: int = 32, reps: int = 8) -> None:
-    # First backend touch in a KILLABLE subprocess (bench._probe_backend):
-    # an in-process jax.devices() on a hung tunnel would hang this probe
-    # (the axon sitecustomize re-exports JAX_PLATFORMS, so only the
-    # config pin forces CPU).
     import bench
 
-    platform = bench._probe_backend(
-        float(os.environ.get("DDL_BENCH_PROBE_TIMEOUT_S", "120"))
-    )
-    if platform != "tpu":
-        os.environ["JAX_PLATFORMS"] = platform
-        import jax
-
-        jax.config.update("jax_platforms", platform)
+    bench.pin_platform()  # killable probe + CPU pin on a down tunnel
     import jax
 
     from ddl_tpu.ingest import measure_h2d_bandwidth
