@@ -10,8 +10,9 @@
 #   2. onchip  - DDL_TPU_ONCHIP=1 pytest tests/test_onchip.py (Mosaic-
 #                compiled flash fwd/bwd, packed segments, window-stream
 #                trainer, stream integrity)
-#   3. bench   - python bench.py (full: ingest+train+fit+sweep) -> JSON
+#   3. bench   - python bench.py (full: ingest+train+fit+sweep+decode)
 #   4. big     - DDL_BENCH_MODE=big python bench.py (HBM-filling MFU)
+#   4b. decode - DDL_BENCH_MODE=decode (serving prefill+decode, MBU)
 set -u
 cd "$(dirname "$0")/.."
 ART="${1:-bench_artifacts}"
@@ -39,6 +40,10 @@ DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
 echo "== [4/5] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
+
+echo "== [4b/5] serving decode bench (small + big, MBU-graded) =="
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
+  2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
 echo "== [5/5] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
