@@ -473,21 +473,27 @@ def _train_config(platform: str, size: str = "small"):
     )
 
 
-def _model_flops_per_token(cfg, seq: int) -> float:
-    """Analytic matmul model-FLOPs per token, fwd+bwd (bwd = 2x fwd).
-
-    Causal attention counted at half the full score matrix (the standard
-    MFU convention — masked positions are not model FLOPs).
-    """
+def _attn_lm_head_flops_per_token(cfg, seq: int) -> float:
+    """Forward matmul FLOPs per token for the parts every decoder family
+    shares — attention (qkv/out projections + causal-half scores and
+    attn@v, the standard MFU convention: masked positions are not model
+    FLOPs) across all layers, plus the lm_head.  Family probes add
+    their own per-layer MLP term (dense SwiGLU here; router + top-k
+    experts in tools/probe_moe.py) so the accounting cannot drift
+    between the published MFU numbers."""
     d, hd = cfg.d_model, cfg.head_dim
     per_layer = (
         2 * d * (cfg.n_heads + 2 * cfg.n_kv_heads) * hd  # qkv proj
         + 2 * cfg.n_heads * hd * d  # out proj
         + 2 * 2 * seq * cfg.n_heads * hd / 2  # scores + attn@v, causal half
-        + 3 * 2 * d * cfg.d_ff  # gate/up/down
     )
-    fwd = cfg.n_layers * per_layer + 2 * d * cfg.vocab  # + lm_head
-    return 3.0 * fwd
+    return cfg.n_layers * per_layer + 2 * d * cfg.vocab
+
+
+def _model_flops_per_token(cfg, seq: int) -> float:
+    """Analytic matmul model-FLOPs per token, fwd+bwd (bwd = 2x fwd)."""
+    mlp = cfg.n_layers * 3 * 2 * cfg.d_model * cfg.d_ff  # gate/up/down
+    return 3.0 * (_attn_lm_head_flops_per_token(cfg, seq) + mlp)
 
 
 def _run_train(platform: str, attn_impl: str, size: str = "small"):
