@@ -28,7 +28,8 @@ HBM-filling train config, "stream" ONLY the window-stream configs —
 the chip-checklist window-size sweep — and "decode" ONLY the
 serving-phase prefill+decode config), DDL_BENCH_PROBE_TIMEOUT_S
 (default 300), DDL_BENCH_STREAM_MIB / DDL_BENCH_LOOKAHEAD /
-DDL_BENCH_NSLOTS (stream geometry).
+DDL_BENCH_NSLOTS (stream geometry), DDL_BENCH_DECODE_BATCH (serving
+batch for the decode configs; default 8 on TPU).
 """
 
 from __future__ import annotations
@@ -605,6 +606,9 @@ def _run_decode(platform: str, size: str = "small"):
         batch, prompt_len, new_tokens, trials = 8, 512, 256, 2
     else:
         batch, prompt_len, new_tokens, trials = 2, 32, 16, 1
+    # Serving batch is the MBU lever (weight reads amortize over the
+    # batch); sweepable for the batch-scaling record.
+    batch = int(os.environ.get("DDL_BENCH_DECODE_BATCH", batch))
 
     params = llama.init_params(cfg, jax.random.key(0))
     rng = np.random.default_rng(0)

@@ -495,14 +495,13 @@ def forward_with_cache(
     cache_idx = jnp.arange(cache["k"].shape[2])
     x = params["embed"].astype(dt)[tokens]
 
-    new_k, new_v = [], []
+    # Stacked-cache value chain, as in llama.forward_with_cache: each
+    # layer writes only its new-token slot so the scan updates in place.
+    k_all, v_all = cache["k"], cache["v"]
     for li, layer in enumerate(params["layers"]):
-        x, ck, cv = _llama._attn_with_cache(
-            layer, x, cfg, cache["k"][li], cache["v"][li], pos,
-            positions, cache_idx,
+        x, k_all, v_all = _llama._attn_with_cache(
+            layer, x, cfg, k_all, v_all, li, pos, positions, cache_idx,
         )
-        new_k.append(ck)
-        new_v.append(cv)
         h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         moe_out, _aux = _moe_mlp_dispatch(h.reshape(B * T, -1), layer, cfg)
         x = x + moe_out.reshape(B, T, -1)
@@ -511,7 +510,7 @@ def forward_with_cache(
     if last_only:
         x = x[:, -1:]
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    return logits, {"k": k_all, "v": v_all}
 
 
 def generate(
