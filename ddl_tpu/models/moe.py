@@ -68,10 +68,12 @@ class MoeConfig:
     #: sort-based dropless routing over ``jax.lax.ragged_dot`` — the
     #: one-hot dispatch/combine einsums (which cost as many real FLOPs
     #: as the experts themselves at single-chip scale) are replaced by
-    #: a sort + gather (measured 1.31x on chip); single-device / tp /
-    #: fsdp layouts only — ``forward`` rejects ep/dp/sp-sharded meshes
-    #: (ragged group boundaries are contiguous local row ranges; a
-    #: token- or expert-sharded axis would force per-layer all-gathers).
+    #: a sort + gather (measured 1.31x on chip).  Token-sharded meshes
+    #: (dp/sp) run the routing per shard under shard_map (dropless, so
+    #: local == global routing exactly); tp/fsdp shard weights and
+    #: compose too.  Only ``ep`` is rejected — ragged group boundaries
+    #: are contiguous local row ranges and cannot align with a sharded
+    #: expert stack; use einsum for expert parallelism.
     moe_impl: str = "einsum"
 
     @property
@@ -204,24 +206,23 @@ def moe_mlp(
 
 def _validate_impl_mesh(cfg: MoeConfig, mesh: Optional[Any]) -> None:
     """The ragged impl's expert groups are contiguous row ranges of a
-    locally sorted copy list: they cannot align with an ``ep``-sharded
-    expert stack, and under a token-sharded axis (``dp``/``sp``) the
-    global ``argsort``/``bincount`` would make GSPMD all-gather every
-    token to every device each layer.  Reject both combinations up
-    front instead of letting GSPMD materialize the gathers silently.
-    (tp/fsdp shard weights, not tokens — those compose fine.)"""
-    if cfg.moe_impl != "ragged" or mesh is None:
-        return
-    for ax in ("ep", "dp", "sp"):
-        if (
-            ax in getattr(mesh, "axis_names", ())
-            and mesh.shape[ax] > 1
-        ):
-            raise ValueError(
-                f"moe_impl='ragged' does not compose with a {ax}>1 mesh "
-                "axis (expert groups are contiguous local row ranges); "
-                "use the einsum impl for ep/dp/sp-sharded training"
-            )
+    locally sorted copy list — they cannot align with an ``ep``-sharded
+    expert stack, so reject that combination up front instead of
+    letting GSPMD materialize a gathered stack silently.  Token-sharded
+    axes (``dp``/``sp``) ARE supported: :func:`_routed_mlp` shard_maps
+    the routing per shard.  tp/fsdp shard weights, not tokens — those
+    compose fine."""
+    if (
+        cfg.moe_impl == "ragged"
+        and mesh is not None
+        and "ep" in getattr(mesh, "axis_names", ())
+        and mesh.shape["ep"] > 1
+    ):
+        raise ValueError(
+            "moe_impl='ragged' does not compose with an ep>1 mesh axis "
+            "(expert groups are contiguous local row ranges); use the "
+            "einsum impl for expert parallelism"
+        )
 
 
 def moe_mlp_ragged(
@@ -282,6 +283,60 @@ def _moe_mlp_dispatch(
     return moe_mlp(x, layer, cfg)
 
 
+def _routed_mlp(
+    h: jax.Array, layer: Params, cfg: MoeConfig, mesh: Optional[Any]
+) -> Tuple[jax.Array, jax.Array]:
+    """The MoE MLP on the (B, T, D) residual stream, mesh-aware.
+
+    Ragged impl on a token-sharded mesh (``dp``/``sp`` axes): routing is
+    per-token and the impl is dropless, so each shard sorts and routes
+    its LOCAL tokens under ``shard_map`` — outputs are identical to the
+    global computation, with zero collectives in the hot path (the same
+    argument ``parallel.ring_attention.sharded_local_attention`` makes
+    for batch-sharded attention; left to GSPMD, the global argsort/
+    bincount would all-gather every token to every device per layer).
+    Expert weights ride in replicated (``ep`` stays rejected —
+    :func:`_validate_impl_mesh`; on an fsdp/tp mesh the shard_map
+    boundary gathers a layer's expert stack per step, the same traffic
+    fsdp training pays at each use point).  The aux loss becomes the
+    shard-mean of per-shard Switch aux — the same load-balance pressure
+    at shard granularity, not numerically equal to the global aux (it
+    is not linear in token subsets; ``forward_pp`` documents the same
+    for microbatch groups).
+    """
+    B, T, D = h.shape
+    if cfg.moe_impl == "ragged" and mesh is not None:
+        names = getattr(mesh, "axis_names", ())
+        bax = "dp" if "dp" in names and mesh.shape["dp"] > 1 else None
+        sax = "sp" if "sp" in names and mesh.shape["sp"] > 1 else None
+        if (bax and B % mesh.shape["dp"] != 0) or (
+            sax and T % mesh.shape["sp"] != 0
+        ):
+            raise ValueError(
+                "moe_impl='ragged': dp/sp mesh axes must divide the "
+                f"(B={B}, T={T}) token grid"
+            )
+        if bax or sax:
+            from jax import shard_map
+
+            axes = tuple(a for a in (bax, sax) if a)
+            layer_specs = jax.tree.map(lambda _: P(), layer)
+
+            def body(hs: jax.Array, lyr: Params):
+                b, t, _ = hs.shape
+                out, aux = moe_mlp_ragged(hs.reshape(b * t, -1), lyr, cfg)
+                return out.reshape(b, t, -1), jax.lax.pmean(aux, axes)
+
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(P(bax, sax, None), layer_specs),
+                out_specs=(P(bax, sax, None), P()),
+                check_vma=False,
+            )(h, layer)
+    out, aux = _moe_mlp_dispatch(h.reshape(B * T, -1), layer, cfg)
+    return out.reshape(B, T, -1), aux
+
+
 def _layer_apply(
     layer: Params,
     x: jax.Array,
@@ -295,13 +350,12 @@ def _layer_apply(
     :func:`forward_pp`.  The attention sub-block is llama's
     ``_attn_block`` (one implementation across families); only the MLP
     differs — routed experts instead of SwiGLU."""
-    B, T = x.shape[:2]
     x = _llama._attn_block(
         layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
     )
     h = _llama._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    moe_out, aux = _moe_mlp_dispatch(h.reshape(B * T, -1), layer, cfg)
-    return x + moe_out.reshape(B, T, -1), aux
+    moe_out, aux = _routed_mlp(h, layer, cfg, mesh)
+    return x + moe_out, aux
 
 
 def forward(
@@ -399,6 +453,25 @@ def forward_pp(
     (it is not linear in token subsets).
     """
     _validate_impl_mesh(cfg, mesh)
+    names = getattr(mesh, "axis_names", ())
+    if cfg.moe_impl == "ragged" and not (
+        axis in names and mesh.shape[axis] > 1
+    ):
+        # Without a real pp axis, pipeline_apply falls back to a
+        # sequential lax.map OUTSIDE shard_map (pipeline.py), where the
+        # layer body runs with mesh=None — a token-sharded dp/sp axis
+        # would then hit moe_mlp_ragged's global argsort under GSPMD
+        # and all-gather every token per layer.  (With pp>1 the
+        # pipeline's shard_map makes dp manual, so local routing is
+        # correct and fast — same argument as _routed_mlp.)
+        for ax in ("dp", "sp"):
+            if ax in names and mesh.shape[ax] > 1:
+                raise ValueError(
+                    f"moe_impl='ragged' with forward_pp needs a real "
+                    f"{axis}>1 mesh axis when {ax}>1 (the sequential "
+                    "fallback would gather token shards); use the "
+                    "einsum impl or a pipelined mesh"
+                )
     B, T = tokens.shape
     dt = cfg.dtype
     positions = jnp.arange(T)

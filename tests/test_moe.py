@@ -119,18 +119,50 @@ class TestRaggedImpl:
                 np.asarray(out[:, t]),
             )
 
-    @pytest.mark.parametrize(
-        "axes, bad", [({"dp": 2, "ep": 4}, "ep"), ({"dp": 8}, "dp")]
-    )
-    def test_rejected_on_sharded_token_or_expert_mesh(self, axes, bad):
-        """ragged + ep (sharded expert stack) or dp/sp (token-sharded
-        global argsort → per-layer all-gathers) — forward refuses up
-        front; tp/fsdp-only meshes stay allowed."""
+    def test_rejected_on_ep_mesh(self):
+        """ragged + ep>1 cannot compose (group boundaries vs sharded
+        expert stack) — forward refuses up front."""
         cfg = _cfg(moe_impl="ragged")
         params = moe.init_params(cfg, jax.random.key(0))
-        mesh = make_mesh(axes)
+        mesh = make_mesh({"dp": 2, "ep": 4})
         toks = jnp.zeros((2, 8), jnp.int32)
-        with pytest.raises(ValueError, match=f"ragged.*{bad}"):
+        with pytest.raises(ValueError, match="ragged.*ep"):
+            moe.forward(params, toks, cfg, mesh=mesh)
+
+    def test_dp_mesh_matches_unsharded(self, rng):
+        """Per-shard local routing over dp == the global computation
+        (dropless: routing is per-token), and it trains."""
+        cfg = _cfg(moe_impl="ragged", topk=2)
+        mesh = make_mesh({"dp": 8})
+        params = moe.init_params(cfg, jax.random.key(0))
+        toks = jnp.asarray(rng.integers(0, cfg.vocab, (8, 16)), jnp.int32)
+        logits_dp, aux_dp = moe.forward(params, toks, cfg, mesh=mesh)
+        logits_1, _aux_1 = moe.forward(params, toks, cfg, mesh=None)
+        np.testing.assert_allclose(
+            np.asarray(logits_dp), np.asarray(logits_1), atol=2e-4
+        )
+        # Shard-mean aux equals global aux only when shards are
+        # balanced identically; just require plausibility here.
+        assert np.isfinite(float(aux_dp))
+
+        init_fn, step_fn = make_train_step(
+            lambda p, b: moe.next_token_loss(p, b, cfg, mesh=mesh),
+            optax.adamw(1e-2), mesh, moe.param_specs(cfg),
+            batch_spec=P(("dp",)),
+        )
+        state = init_fn(params)
+        state, l1 = step_fn(state, np.asarray(toks))
+        state, l2 = step_fn(state, np.asarray(toks))
+        assert float(l2) < float(l1)
+
+    def test_ragged_rejects_nondividing_token_axis(self):
+        """dp that does not divide B must fail loudly, not silently
+        gather."""
+        cfg = _cfg(moe_impl="ragged")
+        mesh = make_mesh({"dp": 8})
+        params = moe.init_params(cfg, jax.random.key(0))
+        toks = jnp.zeros((3, 8), jnp.int32)
+        with pytest.raises(ValueError, match="divide"):
             moe.forward(params, toks, cfg, mesh=mesh)
 
     def test_unknown_impl_rejected(self):
