@@ -295,14 +295,18 @@ def _routed_mlp(
     argument ``parallel.ring_attention.sharded_local_attention`` makes
     for batch-sharded attention; left to GSPMD, the global argsort/
     bincount would all-gather every token to every device per layer).
-    Expert weights ride in replicated (``ep`` stays rejected —
-    :func:`_validate_impl_mesh`; on an fsdp/tp mesh the shard_map
-    boundary gathers a layer's expert stack per step, the same traffic
-    fsdp training pays at each use point).  The aux loss becomes the
-    shard-mean of per-shard Switch aux — the same load-balance pressure
-    at shard granularity, not numerically equal to the global aux (it
-    is not linear in token subsets; ``forward_pp`` documents the same
-    for microbatch groups).
+    A ``tp`` axis Megatron-splits the per-expert hidden dimension
+    INSIDE the shard_map (gate/up column-sharded, down row-sharded,
+    one ``psum`` over tp on the partial outputs) so tp devices divide
+    the expert FLOPs rather than replicate them; tp that does not
+    divide ``d_ff`` falls back to replicated expert compute.  ``ep``
+    stays rejected — :func:`_validate_impl_mesh`.  On an fsdp mesh the
+    shard_map boundary gathers a layer's expert stack per step, the
+    same traffic fsdp training pays at each use point.  The aux loss
+    becomes the shard-mean of per-shard Switch aux — the same
+    load-balance pressure at shard granularity, not numerically equal
+    to the global aux (it is not linear in token subsets;
+    ``forward_pp`` documents the same for microbatch groups).
     """
     B, T, D = h.shape
     if cfg.moe_impl == "ragged" and mesh is not None:
@@ -316,16 +320,36 @@ def _routed_mlp(
                 "moe_impl='ragged': dp/sp mesh axes must divide the "
                 f"(B={B}, T={T}) token grid"
             )
-        if bax or sax:
+        tax = (
+            "tp"
+            if "tp" in names
+            and mesh.shape["tp"] > 1
+            and cfg.d_ff % mesh.shape["tp"] == 0
+            else None
+        )
+        if bax or sax or tax:
             from jax import shard_map
 
-            axes = tuple(a for a in (bax, sax) if a)
-            layer_specs = jax.tree.map(lambda _: P(), layer)
+            token_axes = tuple(a for a in (bax, sax) if a)
+            ff_specs = {
+                "w_gate": P(None, None, tax),
+                "w_up": P(None, None, tax),
+                "w_down": P(None, tax, None),
+            }
+            layer_specs = {
+                k: ff_specs.get(k, P()) for k in layer
+            }
 
             def body(hs: jax.Array, lyr: Params):
                 b, t, _ = hs.shape
                 out, aux = moe_mlp_ragged(hs.reshape(b * t, -1), lyr, cfg)
-                return out.reshape(b, t, -1), jax.lax.pmean(aux, axes)
+                if tax:
+                    # Each tp shard computed its d_ff slice; the down
+                    # projections are partial sums over the hidden dim.
+                    out = jax.lax.psum(out, tax)
+                if token_axes:
+                    aux = jax.lax.pmean(aux, token_axes)
+                return out.reshape(b, t, -1), aux
 
             return shard_map(
                 body, mesh=mesh,
