@@ -389,9 +389,13 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jax.Array:
     """Autoregressive generation: greedy (``temperature == 0``) or
-    temperature sampling.  Returns (B, prompt_len + max_new_tokens).
+    temperature sampling, optionally filtered by ``top_k`` and/or
+    nucleus ``top_p`` (temperature applied first, then the filters).
+    Returns (B, prompt_len + max_new_tokens).
 
     Sampling (``temperature > 0``) REQUIRES an explicit ``key`` — a
     silent default would make "sampled" generation deterministically
@@ -403,8 +407,40 @@ def generate(
     """
     return _generate(
         forward_with_cache, init_cache, params, prompt, cfg,
-        max_new_tokens, temperature, key,
+        max_new_tokens, temperature, key, top_k=top_k, top_p=top_p,
     )
+
+
+def _sample_filter(
+    logits_t: jax.Array, top_k: Optional[int], top_p: Optional[float]
+) -> jax.Array:
+    """Mask logits for top-k / nucleus (top-p) sampling — static-shape
+    ops only, safe inside the decode scan.
+
+    top-k keeps the k highest logits; top-p keeps the smallest prefix
+    of the probability-sorted vocab whose mass reaches ``top_p`` (the
+    first token is always kept, so the filter can never empty the
+    support).  Both filters compose (applied in that order, the
+    conventional stacking)."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logits_t, top_k)[0][..., -1:]
+        logits_t = jnp.where(logits_t < kth, -jnp.inf, logits_t)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits_t, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep ranks whose PRECEDING mass is < top_p (rank 0 always).
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
+            axis=-1,
+        )
+        # Threshold logit: the smallest kept logit per row.
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True,
+        )
+        logits_t = jnp.where(logits_t < cutoff, -jnp.inf, logits_t)
+    return logits_t
 
 
 def _generate(
@@ -416,12 +452,27 @@ def _generate(
     max_new_tokens: int,
     temperature: float,
     key: Optional[jax.Array],
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jax.Array:
     """Family-agnostic generation core (llama and moe share it): prefill
     via one cached forward, then ``lax.scan`` decode steps over a
     static-shape cache.  ``fwd_cache(params, tokens, cfg, cache, pos,
     last_only=...) -> (logits, cache)`` and ``init_cache_fn(cfg, B, L)``
-    are the family's decode hooks."""
+    are the family's decode hooks.  ``top_k``/``top_p`` filter the
+    sampling distribution (:func:`_sample_filter`); both require
+    ``temperature > 0``."""
+    if (top_k is not None or top_p is not None) and temperature <= 0.0:
+        raise ValueError(
+            "top_k/top_p filter the SAMPLING distribution — they have "
+            "no effect on greedy decoding; pass temperature > 0"
+        )
+    # Validate filter values eagerly (static Python ints), before any
+    # prefill compute or scan tracing is spent.
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     B, P_len = prompt.shape
     if max_new_tokens <= 0:
         return prompt
@@ -443,9 +494,12 @@ def _generate(
     def pick(logits_t, k):
         if temperature <= 0.0:
             return jnp.argmax(logits_t, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits_t / temperature, axis=-1
-        ).astype(prompt.dtype)
+        # Temperature first, then filters — top-p measures mass of the
+        # TEMPERED distribution (the conventional ordering).
+        filtered = _sample_filter(logits_t / temperature, top_k, top_p)
+        return jax.random.categorical(k, filtered, axis=-1).astype(
+            prompt.dtype
+        )
 
     def step(carry, k):
         cache, last_logits, pos = carry

@@ -617,14 +617,17 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jax.Array:
     """Autoregressive MoE generation — same contract as
-    ``models.llama.generate`` (greedy or explicit-key sampling; prefill
-    in one cached forward, scanned decode steps), completing inference
-    parity across the model families."""
+    ``models.llama.generate`` (greedy or explicit-key sampling with
+    optional top-k / nucleus top-p filtering; prefill in one cached
+    forward, scanned decode steps), completing inference parity across
+    the model families."""
     return _llama._generate(
         forward_with_cache, init_cache, params, prompt, cfg,
-        max_new_tokens, temperature, key,
+        max_new_tokens, temperature, key, top_k=top_k, top_p=top_p,
     )
 
 

@@ -65,6 +65,12 @@ def test_train_vit_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_generate_example():
+    out = _run("generate.py")
+    assert "OK" in out
+
+
+@pytest.mark.slow
 def test_global_shuffle_example():
     out = _run("global_shuffle.py")
     assert "PASS" in out

@@ -376,6 +376,77 @@ class TestLlamaDecode:
                 params, prompt, cfg, max_new_tokens=2, temperature=0.7
             )
 
+    def test_sample_filter_top_k(self):
+        """top-k masks everything but the k best logits; k=1 makes
+        sampling deterministic-greedy at any temperature."""
+        logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0], [0.0, 5.0, 4.0, 1.0]])
+        f = llama._sample_filter(logits, top_k=2, top_p=None)
+        np.testing.assert_array_equal(
+            np.isfinite(np.asarray(f)),
+            [[True, False, True, False], [False, True, True, False]],
+        )
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab)
+        greedy = llama.generate(params, prompt, cfg, max_new_tokens=4)
+        k1 = llama.generate(
+            params, prompt, cfg, max_new_tokens=4, temperature=1.3,
+            key=jax.random.key(9), top_k=1,
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    def test_sample_filter_top_p(self):
+        """Nucleus filter keeps the smallest prefix reaching mass p;
+        the best token always survives, and p=1.0 keeps everything."""
+        # Probabilities ~ [0.643, 0.236, 0.087, 0.032] for these logits.
+        logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+        f = llama._sample_filter(logits, top_k=None, top_p=0.7)
+        np.testing.assert_array_equal(
+            np.isfinite(np.asarray(f)), [[True, True, False, False]]
+        )
+        f_tiny = llama._sample_filter(logits, top_k=None, top_p=0.01)
+        np.testing.assert_array_equal(
+            np.isfinite(np.asarray(f_tiny)), [[True, False, False, False]]
+        )
+        f_all = llama._sample_filter(logits, top_k=None, top_p=1.0)
+        assert np.isfinite(np.asarray(f_all)).all()
+
+    def test_sampled_tokens_stay_in_filtered_support(self):
+        """End to end: every token sampled with top_k=3 lies in that
+        step's top-3 set (checked via teacher forcing on the output)."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(4), (2, 5), 0, cfg.vocab)
+        out = llama.generate(
+            params, prompt, cfg, max_new_tokens=6, temperature=1.0,
+            key=jax.random.key(11), top_k=3,
+        )
+        logits = llama.forward(params, out, cfg)
+        for t in range(5, 11):
+            top3 = np.asarray(
+                jax.lax.top_k(logits[:, t - 1], 3)[1]
+            )
+            tok = np.asarray(out[:, t])
+            for b in range(2):
+                assert tok[b] in top3[b], (t, b, tok[b], top3[b])
+
+    def test_filters_require_sampling(self):
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="temperature > 0"):
+            llama.generate(params, prompt, cfg, max_new_tokens=2, top_k=5)
+        with pytest.raises(ValueError, match="top_k must be"):
+            llama.generate(
+                params, prompt, cfg, max_new_tokens=2, temperature=1.0,
+                key=jax.random.key(0), top_k=0,
+            )
+        with pytest.raises(ValueError, match="top_p must be"):
+            llama.generate(
+                params, prompt, cfg, max_new_tokens=2, temperature=1.0,
+                key=jax.random.key(0), top_p=1.5,
+            )
+
 
 class TestShardedTrainStep:
     @pytest.mark.parametrize(
