@@ -45,6 +45,23 @@ def _moe_flops_per_token(cfg, seq: int) -> float:
     return 3.0 * (bench._attn_lm_head_flops_per_token(cfg, seq) + mlp)
 
 
+def _probe_cfg(platform: str, impl: str, **overrides):
+    """ONE config for the train and decode probes (the README's 'same
+    model' claim must not be able to drift between them)."""
+    from ddl_tpu.models import moe
+
+    if platform == "tpu":
+        base = dict(
+            vocab=8192, d_model=2048, n_layers=4, n_heads=16,
+            n_kv_heads=8, d_ff=4096, n_experts=8, topk=2, max_seq=2048,
+            moe_impl=impl,
+        )
+    else:
+        base = dict(max_seq=256, moe_impl=impl)
+    base.update(overrides)
+    return moe.MoeConfig(**base)
+
+
 def run_one(platform: str, impl: str) -> None:
     import bench
     import jax
@@ -54,15 +71,10 @@ def run_one(platform: str, impl: str) -> None:
     from ddl_tpu.parallel.mesh import make_mesh
     from ddl_tpu.parallel.train import make_multistep
 
+    cfg = _probe_cfg(platform, impl)
     if platform == "tpu":
-        cfg = moe.MoeConfig(
-            vocab=8192, d_model=2048, n_layers=4, n_heads=16,
-            n_kv_heads=8, d_ff=4096, n_experts=8, topk=2, max_seq=2048,
-            moe_impl=impl,
-        )
         batch, seq, steps = 4, 2048, 12
     else:
-        cfg = moe.MoeConfig(max_seq=256, moe_impl=impl)
         batch, seq, steps = 2, 128, 4
 
     mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
@@ -115,6 +127,87 @@ def run_one(platform: str, impl: str) -> None:
     }))
 
 
+def run_decode(platform: str, impl: str) -> None:
+    """Serving-phase MoE: batched greedy generate through the KV-cache
+    path, by ``bench._run_decode``'s method — whole program jitted,
+    clock stopped by host read-back of the tokens, prefill timed alone
+    so decode-only throughput is separated, and per-trial gating inside
+    ``best_valid`` (valid vocab ids, positive decode span) so an
+    artifact trial can never win selection."""
+    import bench
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.models import moe
+
+    cfg = _probe_cfg(
+        platform, impl,
+        **({"param_dtype": jnp.bfloat16} if platform == "tpu" else {}),
+    )
+    if platform == "tpu":
+        batch, prompt_len, new_tokens, trials = 8, 256, 128, 2
+    else:
+        batch, prompt_len, new_tokens, trials = 2, 16, 8, 1
+
+    params = moe.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab, (batch, prompt_len)).astype(np.int32)
+    )
+
+    @jax.jit
+    def gen(p, toks):
+        return moe.generate(p, toks, cfg, max_new_tokens=new_tokens)
+
+    @jax.jit
+    def prefill(p, toks):
+        cache = moe.init_cache(cfg, batch, prompt_len + new_tokens)
+        logits, _cache = moe.forward_with_cache(
+            p, toks, cfg, cache, jnp.int32(0), last_only=True
+        )
+        return logits
+
+    np.asarray(gen(params, prompt))  # compile + warm
+    np.asarray(prefill(params, prompt))
+    steps = new_tokens - 1
+
+    def one_trial():
+        t0 = time.perf_counter()
+        out = np.asarray(gen(params, prompt))
+        total_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(prefill(params, prompt))
+        prefill_s = time.perf_counter() - t0
+        gen_tok = out[:, prompt_len:]
+        if gen_tok.shape != (batch, new_tokens) or not (
+            (gen_tok >= 0) & (gen_tok < cfg.vocab)
+        ).all():
+            raise RuntimeError("decode produced invalid tokens")
+        decode_s = total_s - prefill_s
+        if decode_s <= 0:
+            raise RuntimeError(
+                f"implausible decode span {decode_s * 1e3:.2f} ms "
+                f"(total {total_s * 1e3:.2f}, prefill "
+                f"{prefill_s * 1e3:.2f}) — timing artifact, rejected"
+            )
+        return decode_s, prefill_s
+
+    decode_s, prefill_s = bench.best_valid(
+        trials, one_trial, key=lambda r: r[0]
+    )
+    print(json.dumps({
+        "family": "moe-decode",
+        "moe_impl": impl,
+        "platform": platform,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "prefill_ms": round(prefill_s * 1e3, 2),
+        "decode_tokens_per_sec": round(batch * steps / decode_s, 1),
+        "decode_step_ms": round(decode_s / steps * 1e3, 3),
+    }))
+
+
 def main() -> None:
     import bench
 
@@ -123,6 +216,8 @@ def main() -> None:
     impls = ("einsum", "ragged") if which == "both" else (which,)
     for impl in impls:
         run_one(platform, impl)
+    for impl in impls:
+        run_decode(platform, impl)
 
 
 if __name__ == "__main__":
