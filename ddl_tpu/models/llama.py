@@ -391,10 +391,13 @@ def generate(
     key: Optional[jax.Array] = None,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
 ) -> jax.Array:
     """Autoregressive generation: greedy (``temperature == 0``) or
     temperature sampling, optionally filtered by ``top_k`` and/or
     nucleus ``top_p`` (temperature applied first, then the filters).
+    With ``eos_id``, a row that emits it keeps emitting ``eos_id`` for
+    the remaining positions (static shapes; truncate at the first EOS).
     Returns (B, prompt_len + max_new_tokens).
 
     Sampling (``temperature > 0``) REQUIRES an explicit ``key`` — a
@@ -408,6 +411,7 @@ def generate(
     return _generate(
         forward_with_cache, init_cache, params, prompt, cfg,
         max_new_tokens, temperature, key, top_k=top_k, top_p=top_p,
+        eos_id=eos_id,
     )
 
 
@@ -454,6 +458,7 @@ def _generate(
     key: Optional[jax.Array],
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
 ) -> jax.Array:
     """Family-agnostic generation core (llama and moe share it): prefill
     via one cached forward, then ``lax.scan`` decode steps over a
@@ -461,7 +466,12 @@ def _generate(
     last_only=...) -> (logits, cache)`` and ``init_cache_fn(cfg, B, L)``
     are the family's decode hooks.  ``top_k``/``top_p`` filter the
     sampling distribution (:func:`_sample_filter`); both require
-    ``temperature > 0``."""
+    ``temperature > 0``.
+
+    ``eos_id``: once a row emits it, every later position in that row
+    is ``eos_id`` too (the scan's shapes are static so the compute
+    still runs; finished rows are masked, the standard TPU serving
+    semantics — the caller truncates at the first EOS)."""
     if (top_k is not None or top_p is not None) and temperature <= 0.0:
         raise ValueError(
             "top_k/top_p filter the SAMPLING distribution — they have "
@@ -473,6 +483,12 @@ def _generate(
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        # An out-of-range id can never be emitted, silently disabling
+        # EOS handling (tokenizer/model vocab mismatch) — fail loudly.
+        raise ValueError(
+            f"eos_id {eos_id} outside the model vocab [0, {cfg.vocab})"
+        )
     B, P_len = prompt.shape
     if max_new_tokens <= 0:
         return prompt
@@ -502,20 +518,26 @@ def _generate(
         )
 
     def step(carry, k):
-        cache, last_logits, pos = carry
+        cache, last_logits, pos, done = carry
         tok = pick(last_logits, k)
+        if eos_id is not None:
+            tok = jnp.where(done, jnp.asarray(eos_id, tok.dtype), tok)
+            done = done | (tok == eos_id)
         logits_t, cache = fwd_cache(
             params, tok[:, None], cfg, cache, pos
         )
-        return (cache, logits_t[:, 0], pos + 1), tok
+        return (cache, logits_t[:, 0], pos + 1, done), tok
 
     # Scan max_new_tokens - 1 steps; the final token needs no forward of
     # its own (its logits would be discarded).
     keys = jax.random.split(key, max_new_tokens)
-    (_, last, _), new_tokens = jax.lax.scan(
-        step, (cache, last, jnp.int32(P_len)), keys[:-1],
+    done0 = jnp.zeros((B,), bool)
+    (_, last, _, done), new_tokens = jax.lax.scan(
+        step, (cache, last, jnp.int32(P_len), done0), keys[:-1],
     )
     final = pick(last, keys[-1])
+    if eos_id is not None:
+        final = jnp.where(done, jnp.asarray(eos_id, final.dtype), final)
     new = jnp.concatenate(
         [new_tokens.swapaxes(0, 1), final[:, None]], axis=1
     ) if max_new_tokens > 1 else final[:, None]

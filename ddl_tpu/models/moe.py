@@ -619,15 +619,17 @@ def generate(
     key: Optional[jax.Array] = None,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
 ) -> jax.Array:
     """Autoregressive MoE generation — same contract as
     ``models.llama.generate`` (greedy or explicit-key sampling with
-    optional top-k / nucleus top-p filtering; prefill in one cached
-    forward, scanned decode steps), completing inference parity across
-    the model families."""
+    optional top-k / nucleus top-p filtering and EOS masking; prefill
+    in one cached forward, scanned decode steps), completing inference
+    parity across the model families."""
     return _llama._generate(
         forward_with_cache, init_cache, params, prompt, cfg,
         max_new_tokens, temperature, key, top_k=top_k, top_p=top_p,
+        eos_id=eos_id,
     )
 
 

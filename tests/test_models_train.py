@@ -430,6 +430,42 @@ class TestLlamaDecode:
             for b in range(2):
                 assert tok[b] in top3[b], (t, b, tok[b], top3[b])
 
+    def test_eos_masks_rest_of_row(self):
+        """Once a row emits eos_id, every later position is eos_id; up
+        to (and including) the first EOS the output matches the run
+        without EOS handling."""
+        cfg = llama.LlamaConfig(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(4), (2, 5), 0, cfg.vocab)
+        free = np.asarray(
+            llama.generate(params, prompt, cfg, max_new_tokens=8)
+        )
+        # Choose the token row 0 emits at its second decode step as EOS.
+        eos = int(free[0, 6])
+        out = np.asarray(
+            llama.generate(
+                params, prompt, cfg, max_new_tokens=8, eos_id=eos
+            )
+        )
+        for b in range(2):
+            hits = np.where(out[b, 5:] == eos)[0]
+            if hits.size:
+                first = 5 + hits[0]
+                # Prefix (through the first EOS) is unchanged...
+                np.testing.assert_array_equal(
+                    out[b, : first + 1], free[b, : first + 1]
+                )
+                # ...and everything after it is EOS.
+                assert (out[b, first:] == eos).all(), out[b]
+            else:
+                np.testing.assert_array_equal(out[b], free[b])
+        # Row 0 definitely hit it at position 6.
+        assert (out[0, 6:] == eos).all(), out[0]
+        with pytest.raises(ValueError, match="outside the model vocab"):
+            llama.generate(
+                params, prompt, cfg, max_new_tokens=2, eos_id=cfg.vocab
+            )
+
     def test_filters_require_sampling(self):
         cfg = llama.LlamaConfig(dtype=jnp.float32)
         params = llama.init_params(cfg, jax.random.key(0))
