@@ -576,6 +576,41 @@ def _run_train(platform: str, attn_impl: str, size: str = "small"):
     }
 
 
+def decode_trial(
+    gen_call, prefill_call, batch: int, prompt_len: int,
+    new_tokens: int, vocab: int,
+):
+    """One timed serving trial, shared by the bench and tools/
+    probe_moe.py so the decode method cannot drift between published
+    numbers: time ``gen_call`` (must end in a host read-back), then
+    ``prefill_call`` alone; validate the generated tokens and the
+    decode span; return ``(decode_s, prefill_s)``.  Raises on invalid
+    tokens or a non-positive span — run it under :func:`best_valid` so
+    an artifact trial can never win selection.  Both calls are
+    host-synchronized HERE (``np.asarray``) so a caller passing bare
+    async jitted functions cannot accidentally time dispatch only."""
+    t0 = time.perf_counter()
+    out = np.asarray(gen_call())
+    total_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(prefill_call())
+    prefill_s = time.perf_counter() - t0
+
+    gen_tok = out[:, prompt_len:]
+    if gen_tok.shape != (batch, new_tokens) or not (
+        (gen_tok >= 0) & (gen_tok < vocab)
+    ).all():
+        raise RuntimeError("decode produced invalid tokens")
+    decode_s = total_s - prefill_s
+    if decode_s <= 0:
+        raise RuntimeError(
+            f"implausible decode span {decode_s * 1e3:.2f} ms (total "
+            f"{total_s * 1e3:.2f}, prefill {prefill_s * 1e3:.2f}) — "
+            "timing artifact, rejected"
+        )
+    return decode_s, prefill_s
+
+
 def _run_decode(platform: str, size: str = "small"):
     """Serving-phase benchmark: KV-cache prefill + autoregressive decode.
 
@@ -643,23 +678,14 @@ def _run_decode(platform: str, size: str = "small"):
         plausibility gate runs per trial INSIDE ``best_valid`` — a
         gate-after-selection would let an artifact run win selection
         and discard its valid companions (see ``best_valid``)."""
-        t0 = time.perf_counter()
-        out = np.asarray(gen(params, prompt))  # host read-back in-window
-        total_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        np.asarray(prefill(params, prompt))
-        prefill_s = time.perf_counter() - t0
-
-        gen_tokens = out[:, prompt_len:]
-        if gen_tokens.shape != (batch, new_tokens) or not (
-            (gen_tokens >= 0) & (gen_tokens < cfg.vocab)
-        ).all():
-            raise RuntimeError("decode produced invalid tokens")
-
         # Decode-only span: the generate program minus its in-program
         # prefill; max_new_tokens - 1 scanned forward steps produce the
         # remaining tokens (the last needs no forward of its own).
-        decode_s = max(total_s - prefill_s, 1e-9)
+        decode_s, prefill_s = decode_trial(
+            lambda: gen(params, prompt),
+            lambda: prefill(params, prompt),
+            batch, prompt_len, new_tokens, cfg.vocab,
+        )
         mbu = (
             n_params * 2 * (steps / decode_s) / peak_hbm
             if peak_hbm else None

@@ -154,6 +154,31 @@ def param_specs(cfg: MoeConfig) -> Params:
     }
 
 
+def _router_topk(
+    x: jax.Array, layer: Params, cfg: MoeConfig
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared router: softmax gate → top-k → renormalised gate weights.
+
+    ONE implementation for both dispatch impls, so their 'identical
+    routing' equivalence holds by construction.  Returns
+    (probs (N, E) fp32, top_p (N, k) renormalised, top_e (N, k) ids).
+    """
+    logits = (x @ layer["w_router"].astype(x.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, cfg.topk)
+    top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+    return probs, top_p, top_e
+
+
+def _switch_aux(probs: jax.Array, top_e: jax.Array, E: int) -> jax.Array:
+    """Switch load-balance loss on slot-0 dispatch decisions —
+    ``E · Σ_e fraction_dispatched(e) · mean_router_prob(e)``."""
+    frac_dispatched = jnp.mean(
+        jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32), axis=0
+    )
+    return E * jnp.sum(frac_dispatched * jnp.mean(probs, axis=0))
+
+
 def moe_mlp(
     x: jax.Array, layer: Params, cfg: MoeConfig
 ) -> Tuple[jax.Array, jax.Array]:
@@ -165,10 +190,7 @@ def moe_mlp(
     E, k, C = cfg.n_experts, cfg.topk, cfg.capacity(N)
     dt = x.dtype
 
-    router_logits = (x @ layer["w_router"].astype(dt)).astype(jnp.float32)
-    probs = jax.nn.softmax(router_logits, axis=-1)  # (N, E)
-    top_p, top_e = jax.lax.top_k(probs, k)  # (N, k)
-    top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+    probs, top_p, top_e = _router_topk(x, layer, cfg)
 
     mask = jax.nn.one_hot(top_e, E, dtype=jnp.float32)  # (N, k, E)
     # Slot-major priority: all slot-0 picks queue before any slot-1 pick.
@@ -196,12 +218,7 @@ def moe_mlp(
         "ecf,efd->ecd", gate * up, layer["w_down"].astype(dt)
     )
     out = jnp.einsum("nec,ecd->nd", combine.astype(dt), expert_out)
-
-    # Switch load-balance loss on slot-0 dispatch decisions.
-    frac_dispatched = jnp.mean(mask[:, 0, :], axis=0)  # (E,)
-    mean_prob = jnp.mean(probs, axis=0)  # (E,)
-    aux = E * jnp.sum(frac_dispatched * mean_prob)
-    return out, aux
+    return out, _switch_aux(probs, top_e, E)
 
 
 def _validate_impl_mesh(cfg: MoeConfig, mesh: Optional[Any]) -> None:
@@ -242,10 +259,7 @@ def moe_mlp_ragged(
     E, k = cfg.n_experts, cfg.topk
     dt = x.dtype
 
-    router_logits = (x @ layer["w_router"].astype(dt)).astype(jnp.float32)
-    probs = jax.nn.softmax(router_logits, axis=-1)  # (N, E)
-    top_p, top_e = jax.lax.top_k(probs, k)  # (N, k)
-    top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+    probs, top_p, top_e = _router_topk(x, layer, cfg)
 
     flat_e = top_e.reshape(-1)  # (N*k,) expert of copy i (token i//k)
     order = jnp.argsort(flat_e)  # stable: ties keep token order
@@ -263,12 +277,7 @@ def moe_mlp_ragged(
     inv = jnp.argsort(order)  # flat copy index -> its sorted row
     per_slot = jnp.take(rows, inv, axis=0).reshape(N, k, D)
     out = jnp.einsum("nk,nkd->nd", top_p.astype(dt), per_slot)
-
-    frac_dispatched = jnp.mean(
-        jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32), axis=0
-    )
-    aux = E * jnp.sum(frac_dispatched * jnp.mean(probs, axis=0))
-    return out, aux
+    return out, _switch_aux(probs, top_e, E)
 
 
 def _moe_mlp_dispatch(

@@ -171,29 +171,14 @@ def run_decode(platform: str, impl: str) -> None:
     np.asarray(prefill(params, prompt))
     steps = new_tokens - 1
 
-    def one_trial():
-        t0 = time.perf_counter()
-        out = np.asarray(gen(params, prompt))
-        total_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        np.asarray(prefill(params, prompt))
-        prefill_s = time.perf_counter() - t0
-        gen_tok = out[:, prompt_len:]
-        if gen_tok.shape != (batch, new_tokens) or not (
-            (gen_tok >= 0) & (gen_tok < cfg.vocab)
-        ).all():
-            raise RuntimeError("decode produced invalid tokens")
-        decode_s = total_s - prefill_s
-        if decode_s <= 0:
-            raise RuntimeError(
-                f"implausible decode span {decode_s * 1e3:.2f} ms "
-                f"(total {total_s * 1e3:.2f}, prefill "
-                f"{prefill_s * 1e3:.2f}) — timing artifact, rejected"
-            )
-        return decode_s, prefill_s
-
     decode_s, prefill_s = bench.best_valid(
-        trials, one_trial, key=lambda r: r[0]
+        trials,
+        lambda: bench.decode_trial(
+            lambda: gen(params, prompt),
+            lambda: prefill(params, prompt),
+            batch, prompt_len, new_tokens, cfg.vocab,
+        ),
+        key=lambda r: r[0],
     )
     print(json.dumps({
         "family": "moe-decode",
