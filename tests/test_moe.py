@@ -155,6 +155,28 @@ class TestRaggedImpl:
         state, l2 = step_fn(state, np.asarray(toks))
         assert float(l2) < float(l1)
 
+    def test_ragged_composes_with_remat(self, rng):
+        """jax.checkpoint over the ragged_dot layer body (the big-model
+        training shape): loss and grads identical to no-remat."""
+        import dataclasses
+
+        cfg = _cfg(moe_impl="ragged", topk=2, remat=True)
+        params = moe.init_params(cfg, jax.random.key(0))
+        toks = jnp.asarray(rng.integers(0, cfg.vocab, (2, 16)), jnp.int32)
+        lr, gr = jax.value_and_grad(
+            lambda p: moe.next_token_loss(p, toks, cfg)
+        )(params)
+        ln, gn = jax.value_and_grad(
+            lambda p: moe.next_token_loss(
+                p, toks, dataclasses.replace(cfg, remat=False)
+            )
+        )(params)
+        np.testing.assert_allclose(float(lr), float(ln), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gn)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            )
+
     def test_sp_mesh_matches_unsharded(self, rng):
         """Sequence-sharded ragged routing (sp axis): per-shard local
         sort over the T slices == global (routing is per-token)."""
