@@ -227,14 +227,22 @@ try:  # import lazily-guarded so `import bench` works before deps resolve
     # DDL_BENCH_STREAM_MIB sweeps the window size (utilization-gap
     # diagnosis, VERDICT r4 item 2); DDL_BENCH_LOOKAHEAD deepens the
     # stream pipeline (pair with DDL_BENCH_NSLOTS >= lookahead+1).
-    STREAM_MIB = int(os.environ.get("DDL_BENCH_STREAM_MIB", "32"))
+    # Defaults are the chip-sweep winner (64 MiB, 3-deep lookahead):
+    # this geometry measured 0.915 of the link in a stable window —
+    # the BASELINE.md >=0.9 north star (bench-stream-northstar-*.json);
+    # 32 MiB / lookahead 1 left ~10% on the table.
+    STREAM_MIB = int(os.environ.get("DDL_BENCH_STREAM_MIB", "64"))
     # Rounded to a whole number of batches (serving truncates ragged tails).
     N_DATA_STREAM = max(
         BATCH, STREAM_MIB * (1 << 20) // (N_VALUES * 4) // BATCH * BATCH
     )
     EPOCHS_STREAM = 16
-    STREAM_LOOKAHEAD = int(os.environ.get("DDL_BENCH_LOOKAHEAD", "1"))
-    STREAM_NSLOTS = int(os.environ.get("DDL_BENCH_NSLOTS", "2"))
+    STREAM_LOOKAHEAD = int(os.environ.get("DDL_BENCH_LOOKAHEAD", "3"))
+    # Default derives from the lookahead so deepening the pipeline via
+    # DDL_BENCH_LOOKAHEAD alone cannot silently under-provision the ring.
+    STREAM_NSLOTS = int(
+        os.environ.get("DDL_BENCH_NSLOTS", str(STREAM_LOOKAHEAD + 1))
+    )
 
     class StreamBenchProducer(ProducerFunctionSkeleton):
         """Zero-copy fill: writes each window straight into the ring slot
@@ -381,7 +389,9 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
     from ddl_tpu.observability import Metrics
 
     metrics = Metrics()
-    n_epochs = EPOCHS_STREAM + 2  # first two windows are warmup/compile
+    # First two windows are warmup/compile; the last STREAM_LOOKAHEAD
+    # are the pipeline drain, excluded from the measured span (below).
+    n_epochs = EPOCHS_STREAM + 2 + STREAM_LOOKAHEAD
 
     @jax.jit
     def consume(w):
@@ -400,30 +410,44 @@ def _run_ingest_stream(link_bytes_per_sec: float = 0.0, mode: str = "thread"):
         samples = 0
         out = None
         seen = 0
+        rate = None
+        report = None
         for win in loader.windows(lookahead=STREAM_LOOKAHEAD):
             if seen == 2:
                 if out is not None:
                     jax.block_until_ready(out)
                 metrics.reset()
                 t0 = time.perf_counter()
-            elif t0 is not None:
+            elif t0 is not None and report is None:
                 # The window yielded at the clock start was already on
                 # device when the clock started — only count later ones.
                 samples += N_DATA_STREAM
             out = consume(win)
             seen += 1
+            # Stop BOTH clocks while dispatches still continue — i.e.
+            # with the lookahead pipeline as full at the stop as it was
+            # at the start.  Ending the span in the drain (the old
+            # accounting) counted the start cohort's pre-clock transfer
+            # work with nothing offsetting it at the tail, inflating
+            # the rate by up to lookahead/EPOCHS_STREAM; with matched
+            # in-flight depth at both edges, completions-per-second
+            # over the span IS the steady-state throughput.
+            if report is None and seen == n_epochs - STREAM_LOOKAHEAD:
+                jax.block_until_ready(out)
+                rate = samples / (time.perf_counter() - t0)
+                # Same-span report (see _run_ingest): registry rates
+                # snapshot at the same instant, inside the consumer
+                # role, so neither drain nor teardown leaks in.  With
+                # completion-time byte accounting (put_window
+                # defer_metrics), registry bytes and wall-clock samples
+                # cover identical windows: bytes/s == samples/s *
+                # bytes_per_sample by construction.
+                report = north_star_report(
+                    metrics, link_bytes_per_sec=link_bytes_per_sec
+                )
             loader.mark(Marker.END_OF_EPOCH)
-        jax.block_until_ready(out)
-        # Same-span report (see _run_ingest): stop both clocks here,
-        # inside the consumer role, so teardown time cannot leak into the
-        # registry rates.  With the stream path's completion-time byte
-        # accounting (DeviceIngestor.put_window defer_metrics), registry
-        # bytes and wall-clock samples now cover identical windows:
-        # bytes/s == samples/s * bytes_per_sample by construction.
-        rate = samples / (time.perf_counter() - t0)
-        return rate, north_star_report(
-            metrics, link_bytes_per_sec=link_bytes_per_sec
-        )
+        jax.block_until_ready(out)  # drain windows run uncounted
+        return rate, report
 
     return main()
 
