@@ -1,0 +1,19 @@
+# Developer entry points. The same commands CI runs; no magic.
+
+PY ?= python
+
+.PHONY: lint test test-fast
+
+# Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
+# Exit 0 = clean; findings print as file:line:col: DDL0xx message.
+lint:
+	$(PY) -m tools.ddl_lint ddl_tpu/ tests/
+
+# Full tier-1 suite (CPU-simulated 8-device mesh).
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# Transport + lint gate only: the quick pre-push loop.
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_transport.py \
+	    tests/test_py_ring.py tests/test_lint.py -q

@@ -29,7 +29,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ddl_tpu.datasetwrapper import ProducerFunctionSkeleton
-from ddl_tpu.exceptions import DoesNotMatchError
+from ddl_tpu.exceptions import DoesNotMatchError, ShutdownRequested
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import ConsumerConnection
 from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer
@@ -482,7 +482,17 @@ class DistributedDataLoader:
     def __del__(self) -> None:  # pragma: no cover - best effort
         try:
             self.shutdown()
+        except ShutdownRequested:
+            # Raced a concurrent teardown: the shutdown flag is already
+            # set, which is all this finalizer wanted.  Handled BY NAME
+            # (DDL007) rather than re-raised — PEP 442 means nothing can
+            # propagate out of a finalizer anyway; an accidental broad
+            # swallow and a deliberate no-op must not look alike.
+            pass
         except Exception:
+            # GC-time shutdown may run after interpreter state this
+            # loader depends on is already gone; anything else is
+            # best-effort by construction.
             pass
 
 

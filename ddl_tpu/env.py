@@ -28,7 +28,7 @@ import os
 import threading
 from typing import Any, Callable, List, Optional
 
-from ddl_tpu.exceptions import TransportError
+from ddl_tpu.exceptions import ShutdownRequested, TransportError
 from ddl_tpu.transport.connection import (
     ConsumerConnection,
     PipeChannel,
@@ -102,11 +102,22 @@ def _producer_main(
                      producer_idx, te)
         conn.channel.close()
         return
+    except ShutdownRequested:
+        # The run is tearing down while this producer was still in its
+        # handshake (e.g. the ring shutdown flag tripped inside an
+        # inplace-fill acquire): a clean, consumer-initiated exit — not a
+        # failure to ship back.  Previously the broad handler below
+        # swallowed this into a spurious "handshake failure" (DDL007).
+        logger.debug("producer %d: shutdown during handshake", producer_idx)
+        conn.channel.close()
+        return
     except Exception as e:
         # Handshake-time user error (bad on_init, bad geometry): ship the
         # exception to the consumer so it fails fast instead of timing out.
         try:
             conn.channel.send(e)
+        except (ShutdownRequested, KeyboardInterrupt):
+            raise
         except Exception:
             # Exception not picklable (open handles, locks): ship a
             # picklable surrogate carrying the traceback text instead.
@@ -119,8 +130,8 @@ def _producer_main(
                         f"(original unpicklable):\n{traceback.format_exc()}"
                     )
                 )
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # channel itself broken; the consumer will time out
         logger.exception("producer %d failed during handshake", producer_idx)
         return
     try:
@@ -273,7 +284,10 @@ class WorkerSet:
         for ch in self.connection.channels:
             try:
                 ch.send(ABORT)
-            except Exception:
+            except (OSError, ValueError):
+                # A dead producer's pipe: EOF/broken-pipe here is the
+                # expected case abort() exists for.  Narrow on purpose
+                # (DDL007): shutdown signals keep propagating.
                 pass
         self.connection.shutdown_operation()
 

@@ -234,7 +234,7 @@ class ConsumerConnection:
                 # persistent shutdown flag.
                 try:
                     channel.close()
-                except Exception:  # pragma: no cover - best-effort
+                except OSError:  # pragma: no cover - best-effort
                     pass
                 raise TransportError(
                     f"rejoin of producer {producer_idx} arrived after "
@@ -242,7 +242,7 @@ class ConsumerConnection:
                 )
             try:
                 self.channels[i].close()
-            except Exception:  # pragma: no cover - already-broken pipe
+            except OSError:  # pragma: no cover - already-broken pipe
                 pass
             self.channels[i] = channel
             self.replies[i] = reply
@@ -266,7 +266,11 @@ class ConsumerConnection:
                 for r in self.replies:
                     try:
                         rings.append(_resolve_ring(r))
-                    except Exception:  # pragma: no cover - best-effort wake
+                    except (TransportError, OSError):
+                        # pragma: no cover - best-effort wake; an
+                        # unresolvable ring only means that producer
+                        # cannot be woken early (its bounded wait still
+                        # times out).  Narrow on purpose (DDL007).
                         pass
             for ring in rings:
                 ring.shutdown()
@@ -282,8 +286,8 @@ class ConsumerConnection:
                 # already unlinked their own).
                 try:
                     ring.unlink()
-                except Exception:  # pragma: no cover - best-effort
-                    pass
+                except (TransportError, OSError):  # pragma: no cover
+                    pass  # best-effort: name may already be gone
             for ch in self.channels:
                 ch.close()
 

@@ -34,18 +34,72 @@ _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
+#: Stable build recipe (everything except the per-invocation paths).
+#: Recorded next to the .so so that a FLAG change — e.g. adding -lrt —
+#: invalidates caches built with the old recipe: the .so is gitignored
+#: and survives `git pull`, so mtime-vs-source alone would reuse an
+#: under-linked library forever on machines that built before the fix.
+_CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+_LDLIBS = ["-lrt"]  # shm_open/shm_unlink live in librt until glibc 2.34
+_BUILD_STAMP = " ".join(["g++", *_CXXFLAGS, *_LDLIBS])
+_STAMP_PATH = _LIB_PATH.with_name(_LIB_PATH.name + ".cmd")
+
+
+def _fresh_lib() -> bool:
+    """Is the built .so present, newer than the source, and built with
+    the current recipe?"""
+    try:
+        return (
+            _LIB_PATH.stat().st_mtime >= _CSRC.stat().st_mtime
+            and _STAMP_PATH.read_text() == _BUILD_STAMP
+        )
+    except OSError:
+        return False
+
+
 def _build_native() -> Path:
-    """Compile the native ring if missing/stale. Returns the .so path."""
+    """Compile the native ring if missing/stale. Returns the .so path.
+
+    ``_build_lock`` serialises builds within one process, but two
+    *processes* importing simultaneously still race: both see a stale
+    .so, both compile (to per-pid tmp names, so the outputs never
+    collide), both ``os.replace``.  That last-writer-wins replace is
+    fine — the contents are identical — but a compile *failure* in one
+    process (e.g. tmpfs briefly full because of the peer's tmp file)
+    must not fail the caller when the peer has meanwhile published a
+    fresh .so.  So: re-stat after a failed compile and use the winner's
+    library instead of propagating, and clean our tmp up on every path.
+    """
     with _build_lock:
-        if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= _CSRC.stat().st_mtime:
+        if _fresh_lib():
             return _LIB_PATH
         tmp = _LIB_PATH.with_suffix(f".{os.getpid()}.tmp.so")
-        cmd = [
-            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            str(_CSRC), "-o", str(tmp),
-        ]
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, _LIB_PATH)
+        # Without -lrt an under-linked .so only loads in processes where
+        # some OTHER import already dragged librt in with RTLD_GLOBAL
+        # (jax/torch in the trainer), and fails with `undefined symbol:
+        # shm_open` in freshly spawned producer processes — silently
+        # demoting them to the polling Python ring.  On glibc >= 2.34
+        # librt is a stub, so the flag is harmless there.
+        cmd = ["g++", *_CXXFLAGS, str(_CSRC), "-o", str(tmp), *_LDLIBS]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, _LIB_PATH)
+            # Stamp AFTER publishing (atomic rename): a crash between the
+            # two leaves a missing/old stamp, i.e. "stale", never a fresh
+            # verdict on a wrong .so.  Concurrent winners write identical
+            # content, so last-writer-wins is safe here too.
+            stamp_tmp = _STAMP_PATH.with_suffix(f".{os.getpid()}.tmp")
+            stamp_tmp.write_text(_BUILD_STAMP)
+            os.replace(stamp_tmp, _STAMP_PATH)
+        except (OSError, subprocess.CalledProcessError):
+            if _fresh_lib():  # a concurrent builder won the race
+                return _LIB_PATH
+            raise
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # normally already renamed away
         return _LIB_PATH
 
 
@@ -60,6 +114,12 @@ def _load_native() -> ctypes.CDLL:
     lib.ddlr_open.argtypes = [ctypes.c_char_p]
     lib.ddlr_acquire_fill.restype = ctypes.c_int
     lib.ddlr_acquire_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    # Void functions declare restype = None explicitly: ctypes defaults
+    # restype to c_int, and the lint gate (DDL008) requires the intent to
+    # be visible so "void" is distinguishable from "forgot" — an
+    # undeclared restype on a pointer-returning binding truncates to 32
+    # bits on LP64.
+    lib.ddlr_commit.restype = None
     lib.ddlr_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
     lib.ddlr_acquire_drain.restype = ctypes.c_int
     lib.ddlr_acquire_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
@@ -67,11 +127,13 @@ def _load_native() -> ctypes.CDLL:
     lib.ddlr_acquire_drain_ahead.argtypes = [
         ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64,
     ]
+    lib.ddlr_release.restype = None
     lib.ddlr_release.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.ddlr_slot_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.ddlr_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.ddlr_slot_payload.restype = ctypes.c_uint64
     lib.ddlr_slot_payload.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ddlr_shutdown.restype = None
     lib.ddlr_shutdown.argtypes = [ctypes.c_void_p]
     lib.ddlr_is_shutdown.restype = ctypes.c_int
     lib.ddlr_is_shutdown.argtypes = [ctypes.c_void_p]
@@ -81,7 +143,9 @@ def _load_native() -> ctypes.CDLL:
     lib.ddlr_nslots.argtypes = [ctypes.c_void_p]
     lib.ddlr_slot_bytes.restype = ctypes.c_uint64
     lib.ddlr_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.ddlr_close.restype = None
     lib.ddlr_close.argtypes = [ctypes.c_void_p]
+    lib.ddlr_unlink.restype = None
     lib.ddlr_unlink.argtypes = [ctypes.c_char_p]
     _lib = lib
     return lib
@@ -96,7 +160,12 @@ def native_available() -> bool:
     try:
         _load_native()
         return True
-    except Exception as e:
+    except (OSError, subprocess.SubprocessError) as e:
+        # Everything the toolchain path can throw: g++ missing/compile
+        # failure (CalledProcessError / FileNotFoundError), CDLL load
+        # failure and source stat failure (OSError).  Deliberately NOT
+        # `except Exception` (DDL007): a ShutdownRequested or programming
+        # error must propagate, not demote the process to the slow ring.
         # Degrading to PyShmRing must be VISIBLE: the fallback refuses
         # non-TSO ISAs and polls instead of event-waiting, so a silently
         # failing g++ build would change both perf and platform support.

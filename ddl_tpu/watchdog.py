@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ddl_tpu.exceptions import ShutdownRequested, TransportError
+
 logger = logging.getLogger("ddl_tpu")
 
 
@@ -88,6 +90,8 @@ class Watchdog:
         logger.error("watchdog: %s — initiating shutdown", reason)
         try:
             self.workers.abort()
+        except (ShutdownRequested, KeyboardInterrupt):
+            raise
         except Exception:  # pragma: no cover - best effort
             pass
 
@@ -164,6 +168,10 @@ class Watchdog:
         while not self._stop.wait(self.poll_interval_s):
             try:
                 reason = self.check_once()
+            except (ShutdownRequested, KeyboardInterrupt):
+                # Teardown reached the monitor thread: stop monitoring,
+                # do not mislabel it as a crashed sweep (DDL007).
+                return
             except Exception:
                 # A crashing sweep must never silently disable failure
                 # detection; log and keep monitoring.
@@ -193,10 +201,13 @@ class Watchdog:
                             committed = self.workers.connection.rings[
                                 idx - 1
                             ].stats()["committed"]
-                        except Exception:  # pragma: no cover
+                        except (TransportError, OSError, KeyError,
+                                IndexError):  # pragma: no cover
                             committed = float("-inf")
                         self._replaying[idx - 1] = committed
                         continue
+                    except (ShutdownRequested, KeyboardInterrupt):
+                        return  # teardown mid-respawn: stop monitoring
                     except Exception:
                         logger.exception(
                             "watchdog: respawn of producer %d failed", idx

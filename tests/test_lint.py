@@ -1,0 +1,428 @@
+"""The lint gate: ddl-lint self-test + zero-findings gate over the tree.
+
+Two halves:
+
+- **Self-test**: a fixture snippet per check, each containing exactly one
+  violation, asserting every ``DDL0xx`` code actually fires (a silently
+  dead checker would otherwise let the gate rot into a no-op), plus
+  clean counterparts asserting the checkers stay quiet on compliant
+  code, plus suppression/config-layer tests.
+- **Gate**: ``run_paths(["ddl_tpu", "tests"])`` must return zero
+  findings — reintroducing any violation fails the tier-1 suite.
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:  # tools.* import under any pytest cwd
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.ddl_lint import ALL_CODES, LintConfig, run_paths  # noqa: E402
+from tools.ddl_lint.checkers import REGISTRY  # noqa: E402
+from tools.ddl_lint.config import _parse_toml_subset, load_config  # noqa: E402
+
+# One snippet per code; each must trigger EXACTLY its own code (plus
+# whatever other codes the same hazard legitimately implies — listed in
+# EXPECTED_EXTRA below).
+VIOLATIONS = {
+    "DDL001": """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print(x)          # host I/O at trace time
+            return x + 1
+    """,
+    "DDL002": """
+        import jax
+
+        seen = []
+
+        @jax.jit
+        def step(x):
+            seen.append(x)    # tracer leaks into post-trace python
+            return x + 1
+    """,
+    "DDL003": """
+        import jax
+
+        def augment(batches):
+            out = []
+            for b in batches:
+                k = jax.random.PRNGKey(0)   # same key every iteration
+                out.append(jax.random.normal(k, b.shape) + b)
+            return out
+    """,
+    "DDL004": """
+        import time
+
+        def wait_for_peer(path):
+            while True:               # no deadline, no shutdown check
+                if _exists(path):
+                    break
+                time.sleep(0.01)
+    """,
+    "DDL005": """
+        import time
+
+        class DistributedDataLoader:
+            def _acquire_current(self):
+                while not self._ring().poll_drain_ready():
+                    time.sleep(0.001)   # dead device time per window
+    """,
+    "DDL006": """
+        import threading
+
+        _build_lock = threading.Lock()
+        _sweep_lock = threading.Lock()
+
+        def rebuild():
+            with _sweep_lock:
+                with _build_lock:       # inverts declared hierarchy
+                    pass
+    """,
+    "DDL007": """
+        def teardown(ch):
+            try:
+                ch.close()
+            except Exception:       # swallows ShutdownRequested
+                pass
+    """,
+    "DDL008": """
+        import ctypes
+
+        lib = ctypes.CDLL("libfoo.so")
+        lib.foo_create.restype = ctypes.c_void_p
+        lib.foo_create.argtypes = [ctypes.c_char_p]
+        lib.foo_close.argtypes = [ctypes.c_void_p]   # no restype
+    """,
+    "DDL009": """
+        import enum
+
+        class Msg(enum.Enum):
+            DATA = 1
+            EOF = 2
+            ABORT = 3
+
+        def dispatch(m):
+            if m is Msg.DATA:
+                return "d"
+            elif m is Msg.EOF:
+                return "e"
+            # no ABORT branch, no else: silently dropped
+    """,
+    "DDL010": """
+        import jax
+
+        def run(batches, f):
+            out = []
+            for b in batches:
+                out.append(jax.jit(f)(b))   # re-wrap per iteration
+            return out
+    """,
+}
+
+# A hazard snippet may legitimately imply a second code (none today, but
+# the self-test structure tolerates it without weakening the exactness
+# check for everyone else).
+EXPECTED_EXTRA = {code: set() for code in VIOLATIONS}
+
+CLEAN = {
+    "DDL001": """
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("x={x}", x=x)   # sanctioned trace-safe print
+            return x + 1
+
+        def host_side(y):
+            print(y)        # host code may print freely
+            y.block_until_ready()
+    """,
+    "DDL003": """
+        import jax
+
+        def augment(key, batches):
+            out = []
+            for b in batches:
+                key, sub = jax.random.split(key)   # carried key
+                out.append(jax.random.normal(sub, b.shape) + b)
+            return out
+    """,
+    "DDL004": """
+        import time
+
+        def wait_for_peer(path, timeout_s, ring):
+            deadline = time.monotonic() + timeout_s
+            while True:
+                if ring.is_shutdown():
+                    raise ShutdownRequested()
+                if _exists(path):
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(path)
+                time.sleep(0.01)
+    """,
+    "DDL006": """
+        import threading
+
+        _build_lock = threading.Lock()
+        _sweep_lock = threading.Lock()
+
+        def rebuild():
+            with _build_lock:
+                with _sweep_lock:       # declared order: build -> sweep
+                    pass
+    """,
+    "DDL007": """
+        def teardown(ch):
+            try:
+                ch.close()
+            except OSError:             # narrowed: signals propagate
+                pass
+
+        def guarded(ch):
+            try:
+                ch.close()
+            except (ShutdownRequested, KeyboardInterrupt):
+                raise
+            except Exception:
+                pass
+    """,
+    "DDL009": """
+        import enum
+
+        class Msg(enum.Enum):
+            DATA = 1
+            EOF = 2
+
+        def dispatch(m):
+            if m is Msg.DATA:
+                return "d"
+            elif m is Msg.EOF:
+                return "e"
+            else:
+                raise ValueError(m)
+
+        def dispatch_exhaustive(m):
+            if m is Msg.DATA:
+                return "d"
+            elif m is Msg.EOF:
+                return "e"
+    """,
+}
+
+
+def lint_snippet(tmp_path, code, snippet, config=None):
+    f = tmp_path / f"fixture_{code.lower()}.py"
+    f.write_text(textwrap.dedent(snippet))
+    return run_paths([str(f)], config=config or LintConfig())
+
+
+class TestSelfTest:
+    def test_registry_covers_every_published_code(self):
+        assert set(REGISTRY) == set(ALL_CODES)
+
+    @pytest.mark.parametrize("code", sorted(VIOLATIONS))
+    def test_each_code_fires_on_its_fixture(self, tmp_path, code):
+        findings = lint_snippet(tmp_path, code, VIOLATIONS[code])
+        fired = {f.code for f in findings}
+        assert code in fired, f"{code} did not fire on its fixture"
+        stray = fired - {code} - EXPECTED_EXTRA[code]
+        assert not stray, f"unexpected extra findings {stray}: {findings}"
+
+    @pytest.mark.parametrize("code", sorted(CLEAN))
+    def test_clean_counterparts_stay_quiet(self, tmp_path, code):
+        findings = lint_snippet(tmp_path, code, CLEAN[code])
+        assert findings == [], findings
+
+    def test_findings_carry_location_and_render(self, tmp_path):
+        findings = lint_snippet(tmp_path, "DDL007", VIOLATIONS["DDL007"])
+        f = findings[0]
+        assert f.line > 1 and f.code == "DDL007"
+        assert f"{f.path}:{f.line}" in f.render()
+
+    def test_syntax_error_reports_ddl000(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        findings = run_paths([str(bad)], config=LintConfig())
+        assert [f.code for f in findings] == ["DDL000"]
+
+    def test_bare_baseexception_swallow_is_flagged(self, tmp_path):
+        """`except BaseException: pass` must not exempt itself by naming
+        the signal it swallows — protection has to be a distinct earlier
+        handler or a re-raise."""
+        src = """
+            def teardown(ch):
+                try:
+                    ch.close()
+                except BaseException:
+                    pass
+        """
+        findings = lint_snippet(tmp_path, "DDL007", src)
+        assert [f.code for f in findings] == ["DDL007"]
+
+    def test_nonexistent_config_file_is_an_error(self, tmp_path):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1\n")
+        with pytest.raises(FileNotFoundError):
+            run_paths([str(f)], config_file=str(tmp_path / "nope.toml"))
+
+    def test_nonexistent_path_is_an_error_not_clean(self, tmp_path):
+        """A typo'd path must fail loudly — a silent empty run would turn
+        the gate into a permanent no-op that reports clean forever."""
+        with pytest.raises(FileNotFoundError):
+            run_paths([str(tmp_path / "no_such_dir")], config=LintConfig())
+
+    def test_same_named_unrelated_enums_do_not_false_positive(
+        self, tmp_path
+    ):
+        """Two different enums sharing a bare class name must not union
+        their members: an exhaustive dispatch over one of them stays
+        clean (the ambiguous name is dropped from DDL009 checking)."""
+        (tmp_path / "a.py").write_text(textwrap.dedent("""
+            import enum
+
+            class Msg(enum.Enum):
+                DATA = 1
+                EOF = 2
+
+            def dispatch(m):
+                if m is Msg.DATA:
+                    return "d"
+                elif m is Msg.EOF:
+                    return "e"
+        """))
+        (tmp_path / "b.py").write_text(textwrap.dedent("""
+            import enum
+
+            class Msg(enum.Enum):
+                PING = 1
+                PONG = 2
+        """))
+        assert run_paths([str(tmp_path)], config=LintConfig()) == []
+
+    def test_ddl008_audits_stored_lib_handle_calls(self, tmp_path):
+        """The repo's real call idiom — `self._lib = _load_native()` then
+        `self._lib.fn(...)` — must be audited, not just bare CDLL vars."""
+        f = tmp_path / "handle.py"
+        f.write_text(textwrap.dedent("""
+            import ctypes
+
+            def _load():
+                lib = ctypes.CDLL("libx.so")
+                lib.x_open.restype = ctypes.c_void_p
+                lib.x_open.argtypes = [ctypes.c_char_p]
+                return lib
+
+            class Ring:
+                def __init__(self):
+                    self._lib = _load()
+                    self._h = self._lib.x_open(b"n")
+
+                def poke(self):
+                    self._lib.x_poke(self._h)   # never declared
+        """))
+        findings = run_paths([str(f)], config=LintConfig())
+        assert [f.code for f in findings] == ["DDL008"]
+        assert "x_poke" in findings[0].message
+
+
+class TestSuppressionAndConfig:
+    def test_inline_disable_comment(self, tmp_path):
+        src = VIOLATIONS["DDL007"].replace(
+            "except Exception:", "except Exception:  # ddl-lint: disable=DDL007"
+        )
+        assert lint_snippet(tmp_path, "DDL007", src) == []
+
+    def test_inline_disable_other_code_does_not_mask(self, tmp_path):
+        src = VIOLATIONS["DDL007"].replace(
+            "except Exception:", "except Exception:  # ddl-lint: disable=DDL001"
+        )
+        findings = lint_snippet(tmp_path, "DDL007", src)
+        assert [f.code for f in findings] == ["DDL007"]
+
+    def test_pragma_inside_string_is_not_a_suppression(self, tmp_path):
+        src = VIOLATIONS["DDL007"] + (
+            '\n        PRAGMA = "# ddl-lint: disable=DDL007"\n'
+        )
+        findings = lint_snippet(tmp_path, "DDL007", src)
+        assert [f.code for f in findings] == ["DDL007"]
+
+    def test_config_disable(self, tmp_path):
+        cfg = LintConfig(disable=["DDL007"])
+        assert lint_snippet(tmp_path, "DDL007", VIOLATIONS["DDL007"], cfg) == []
+
+    def test_per_path_ignores(self, tmp_path):
+        sub = tmp_path / "vendored"
+        sub.mkdir()
+        f = sub / "third_party.py"
+        f.write_text(textwrap.dedent(VIOLATIONS["DDL007"]))
+        cfg = LintConfig(per_path_ignores={str(sub): ["DDL007"]})
+        assert run_paths([str(f)], config=cfg) == []
+
+    def test_toml_subset_parser_reads_our_section(self):
+        tables = _parse_toml_subset(
+            textwrap.dedent(
+                """
+                [project]
+                name = "x"  # unrelated, any TOML allowed here
+
+                [tool.ddl_lint]
+                disable = [
+                    "DDL001",  # inline comments inside arrays must parse
+                    "DDL002",
+                ]
+                hot_path_classes = ["A", "B"]  # trailing comment
+                lock_order = ["has#hash", "b"]
+
+                [tool.ddl_lint.per_path_ignores]
+                "tests/" = ["DDL005"]
+                """
+            )
+        )
+        assert tables["tool.ddl_lint"]["disable"] == ["DDL001", "DDL002"]
+        assert tables["tool.ddl_lint"]["hot_path_classes"] == ["A", "B"]
+        # `#` inside a quoted string is content, not a comment
+        assert tables["tool.ddl_lint"]["lock_order"] == ["has#hash", "b"]
+        assert tables["tool.ddl_lint.per_path_ignores"]["tests/"] == [
+            "DDL005"
+        ]
+
+    def test_load_config_from_pyproject(self, tmp_path):
+        py = tmp_path / "pyproject.toml"
+        py.write_text(
+            "[tool.ddl_lint]\n"
+            'disable = ["DDL010"]\n'
+            'lock_order = ["a_lock", "b_lock"]\n'
+        )
+        cfg = load_config(py)
+        assert "DDL010" in cfg.disable
+        assert cfg.lock_order == ["a_lock", "b_lock"]
+        assert "DDL010" not in cfg.enabled_codes()
+
+
+class TestGate:
+    def test_tree_is_clean(self):
+        """THE gate: the shipped tree must lint clean.  Any reintroduced
+        DDL0xx violation in ddl_tpu/ or tests/ fails tier-1 here."""
+        findings = run_paths(
+            [str(REPO_ROOT / "ddl_tpu"), str(REPO_ROOT / "tests")]
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_gate_would_catch_a_reintroduction(self, tmp_path):
+        """The gate's teeth, demonstrated end to end: a tree containing
+        one known violation does NOT lint clean with the repo config."""
+        victim = tmp_path / "regressed.py"
+        victim.write_text(textwrap.dedent(VIOLATIONS["DDL008"]))
+        findings = run_paths(
+            [str(victim)],
+            config_file=str(REPO_ROOT / "pyproject.toml"),
+        )
+        assert any(f.code == "DDL008" for f in findings)

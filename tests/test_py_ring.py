@@ -1,0 +1,251 @@
+"""PyShmRing under ``DDL_TPU_FORCE_PY_RING=1``: the pure-Python fallback.
+
+The fallback path (no C++ toolchain) previously had no dedicated tests —
+it was only exercised incidentally when the native build happened to be
+missing.  These tests force it explicitly and cover the protocol under
+contention, shutdown-during-wait (the §3.5 any-time-cancellability
+property), the open/attach path, and the end-to-end loader ride.
+
+In-process use is GIL-serialized (safe on any ISA); the one spawned-
+process test carries the TSO guard from ``ringsupport``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddl_tpu.exceptions import (
+    ShutdownRequested,
+    StallTimeoutError,
+    TransportError,
+)
+from ddl_tpu.transport import shm_ring as shm_ring_mod
+from ddl_tpu.transport.shm_ring import (
+    PyShmRing,
+    create_shm_ring,
+    make_ring_name,
+    open_shm_ring,
+)
+from ringsupport import TSO
+
+
+@pytest.fixture
+def force_py(monkeypatch):
+    """Force the fallback and allow it on this (in-process, serialized)
+    interpreter regardless of ISA."""
+    monkeypatch.setenv("DDL_TPU_FORCE_PY_RING", "1")
+    monkeypatch.setenv("DDL_TPU_UNSAFE_PY_RING", "1")
+
+
+@pytest.fixture
+def ring(force_py):
+    r = create_shm_ring(make_ring_name("pyforce"), 2, 256)
+    yield r
+    r.shutdown()
+    r.close()
+    try:
+        r.unlink()
+    except OSError:
+        pass
+
+
+class TestForcedSelection:
+    def test_factories_return_py_ring(self, ring):
+        """With the env knob set, both factories must yield the fallback
+        even though this image has a working g++."""
+        assert isinstance(ring, PyShmRing)
+        peer = open_shm_ring(ring.name)
+        assert isinstance(peer, PyShmRing)
+        assert (peer.nslots, peer.slot_bytes) == (2, 256)
+        peer.close()
+
+    def test_native_available_reports_false(self, force_py):
+        assert shm_ring_mod.native_available() is False
+
+
+class TestProtocol:
+    def test_fifo_handoff_and_payload(self, ring):
+        for i in range(2):
+            slot = ring.acquire_fill(timeout_s=5)
+            view = ring.slot_view(slot)
+            view[:4] = i + 1
+            ring.commit(slot, 4)
+        for i in range(2):
+            slot = ring.acquire_drain(timeout_s=5)
+            assert ring.slot_payload(slot) == 4
+            assert list(ring.slot_view(slot)[:4]) == [i + 1] * 4
+            ring.release(slot)
+
+    def test_fill_blocks_when_full_then_timeout(self, ring):
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)
+        with pytest.raises(StallTimeoutError):
+            ring.acquire_fill(timeout_s=0.2)
+
+    def test_drain_timeout_when_empty(self, ring):
+        with pytest.raises(StallTimeoutError):
+            ring.acquire_drain(timeout_s=0.2)
+
+    def test_drain_ahead_validation_and_lookahead(self, ring):
+        with pytest.raises(ValueError):
+            ring.acquire_drain_ahead(2, timeout_s=0.2)
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)
+        s0 = ring.acquire_drain_ahead(0, timeout_s=5)
+        s1 = ring.acquire_drain_ahead(1, timeout_s=5)
+        assert {s0, s1} == {0, 1}
+        assert ring.poll_drain_ready(0)
+        ring.release(s0)
+        ring.release(s1)
+
+    def test_threaded_producer_consumer(self, ring):
+        """A producer thread and the main-thread consumer exchange 50
+        windows through the 2-slot ring with correct content in order."""
+        n = 50
+
+        def produce():
+            for i in range(n):
+                slot = ring.acquire_fill(timeout_s=30)
+                ring.slot_view(slot)[:8] = np.frombuffer(
+                    np.int64(i).tobytes(), dtype=np.uint8
+                )
+                ring.commit(slot, 8)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        got = []
+        for _ in range(n):
+            slot = ring.acquire_drain(timeout_s=30)
+            got.append(
+                int(ring.slot_view(slot)[:8].view(np.int64)[0])
+            )
+            ring.release(slot)
+        t.join(30)
+        assert not t.is_alive()
+        assert got == list(range(n))
+
+
+class TestShutdown:
+    def test_shutdown_during_blocked_drain(self, ring):
+        """The §3.5 property on the fallback: a consumer blocked in
+        acquire_drain must wake with ShutdownRequested when any thread
+        flips the shutdown flag — well before the wait timeout."""
+        waiter_err = []
+
+        def drain():
+            t0 = time.monotonic()
+            try:
+                ring.acquire_drain(timeout_s=60)
+            except ShutdownRequested:
+                waiter_err.append(("shutdown", time.monotonic() - t0))
+            except StallTimeoutError:  # pragma: no cover - the bug case
+                waiter_err.append(("timeout", time.monotonic() - t0))
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        time.sleep(0.1)  # let it block
+        ring.shutdown()
+        t.join(10)
+        assert not t.is_alive()
+        assert waiter_err and waiter_err[0][0] == "shutdown"
+        assert waiter_err[0][1] < 30, "woke by timeout, not by shutdown"
+
+    def test_shutdown_during_blocked_fill(self, ring):
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)  # ring now full
+
+        def fill():
+            with pytest.raises(ShutdownRequested):
+                ring.acquire_fill(timeout_s=60)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        ring.shutdown()
+        t.join(10)
+        assert not t.is_alive()
+
+    def test_shutdown_flag_is_persistent_across_open(self, ring):
+        ring.shutdown()
+        peer = open_shm_ring(ring.name)
+        assert peer.is_shutdown()
+        with pytest.raises(ShutdownRequested):
+            peer.acquire_drain(timeout_s=1)
+        peer.close()
+
+
+class TestFormatAndGates:
+    def test_open_rejects_native_format_segment(self, force_py, tmp_path):
+        """A py-format open of a non-py segment must fail loudly (magic
+        mismatch), not hand back garbage counters."""
+        name = make_ring_name("badfmt")
+        path = f"/dev/shm/{name.lstrip('/')}"
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 8192)  # header-sized zeros, no magic
+        try:
+            with pytest.raises(TransportError, match="not py-format"):
+                PyShmRing.open(name)
+        finally:
+            os.unlink(path)
+
+    def test_tso_gate_blocks_without_override(self, monkeypatch):
+        """On non-TSO ISAs construction must refuse unless overridden; on
+        TSO machines the gate is a no-op (simulated via the machine
+        probe)."""
+        monkeypatch.setenv("DDL_TPU_FORCE_PY_RING", "1")
+        monkeypatch.delenv("DDL_TPU_UNSAFE_PY_RING", raising=False)
+        import platform
+
+        monkeypatch.setattr(platform, "machine", lambda: "aarch64")
+        with pytest.raises(TransportError, match="total-store-order"):
+            PyShmRing.create(make_ring_name("tso"), 2, 64)
+
+    def test_stats_track_counters(self, ring):
+        ring.commit(ring.acquire_fill(timeout_s=5), 1)
+        st = ring.stats()
+        assert st["committed"] == 1.0 and st["released"] == 0.0
+        ring.release(ring.acquire_drain(timeout_s=5))
+        assert ring.stats()["released"] == 1.0
+
+
+@pytest.mark.skipif(not TSO, reason="cross-process py ring needs TSO")
+class TestLoaderRide:
+    def test_thread_mode_loader_served_by_forced_py_ring(
+        self, force_py, monkeypatch
+    ):
+        """End-to-end: PROCESS-mode-style shm rings forced to the Python
+        implementation still serve a full (single-producer, in-process)
+        drain loop through the public ring API."""
+        # Producer/consumer pair over one forced py ring, window-sized
+        # batches, exactly as DataPusher/DistributedDataLoader drive it.
+        ring = create_shm_ring(make_ring_name("ride"), 2, 4 * 8)
+        assert isinstance(ring, PyShmRing)
+        windows = [np.arange(4, dtype=np.int64) + 10 * k for k in range(5)]
+
+        def produce():
+            try:
+                for w in windows:
+                    slot = ring.acquire_fill(timeout_s=30)
+                    ring.slot_view(slot)[:].view(np.int64)[:] = w
+                    ring.commit(slot, w.nbytes)
+                    # after the last commit the consumer shuts us down
+                ring.acquire_fill(timeout_s=30)
+            except ShutdownRequested:
+                pass
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        seen = []
+        for _ in windows:
+            slot = ring.acquire_drain(timeout_s=30)
+            seen.append(ring.slot_view(slot)[:].view(np.int64).copy())
+            ring.release(slot)
+        ring.shutdown()
+        t.join(30)
+        assert not t.is_alive()
+        np.testing.assert_array_equal(np.stack(seen), np.stack(windows))
+        ring.close()
+        ring.unlink()

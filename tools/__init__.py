@@ -1,0 +1,1 @@
+"""Developer tooling for the ddl_tpu repo (lint suite, bench probes)."""

@@ -1,0 +1,45 @@
+"""ddl-lint: framework-aware static analysis for ddl_tpu.
+
+A custom AST-based suite enforcing the invariants the hand-rolled
+transport layer and the JAX/TPU hot path depend on — the checking the
+reference implementation outsourced to OpenMPI's battle-tested runtime
+and we must do ourselves (ISSUE 1, PAPER.md §2.4).
+
+Checks (see docs/LINT.md for rationale and examples):
+
+- DDL001  host sync / host I/O inside jit/pmap/shard_map
+- DDL002  tracer-leaking closure write inside a traced function
+- DDL003  constant-seed PRNGKey constructed in a loop
+- DDL004  unbounded while-True sleep-poll loop
+- DDL005  time.sleep inside a hot-path class
+- DDL006  lock acquisition against the declared hierarchy
+- DDL007  broad except swallowing ShutdownRequested/KeyboardInterrupt
+- DDL008  ctypes binding missing restype/argtypes
+- DDL009  non-exhaustive enum dispatch without a default
+- DDL010  jax.jit constructed inside a loop
+
+Usage::
+
+    python -m tools.ddl_lint ddl_tpu/ tests/
+
+or in-process (the tier-1 gate, tests/test_lint.py)::
+
+    from tools.ddl_lint import run_paths
+    assert run_paths(["ddl_tpu", "tests"]) == []
+
+Suppression: trailing ``# ddl-lint: disable=DDL0xx`` comment on the
+flagged line; repo policy in ``[tool.ddl_lint]`` (pyproject.toml).
+"""
+
+from tools.ddl_lint.config import ALL_CODES, LintConfig, load_config
+from tools.ddl_lint.findings import Finding, render_report
+from tools.ddl_lint.runner import run_paths
+
+__all__ = [
+    "ALL_CODES",
+    "Finding",
+    "LintConfig",
+    "load_config",
+    "render_report",
+    "run_paths",
+]

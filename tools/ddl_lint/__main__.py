@@ -1,0 +1,50 @@
+"""CLI: ``python -m tools.ddl_lint [paths ...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  Parse failures surface
+as DDL000 findings (exit 1) rather than crashing the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tools.ddl_lint.checkers import REGISTRY
+from tools.ddl_lint.findings import render_report
+from tools.ddl_lint.runner import run_paths
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ddl_lint",
+        description="ddl_tpu framework-invariant static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["ddl_tpu", "tests"],
+        help="files or directories to lint (default: ddl_tpu tests)",
+    )
+    parser.add_argument(
+        "--config", metavar="PYPROJECT",
+        help="explicit pyproject.toml (default: nearest above first path)",
+    )
+    parser.add_argument(
+        "--list-checks", action="store_true",
+        help="list check codes and summaries, then exit",
+    )
+    args = parser.parse_args(argv)
+    if args.list_checks:
+        for code in sorted(REGISTRY):
+            print(f"{code}  {REGISTRY[code].summary}")
+        return 0
+    try:
+        findings = run_paths(args.paths, config_file=args.config)
+    except (OSError, ValueError) as e:
+        print(f"ddl-lint: {e}", file=sys.stderr)
+        return 2
+    print(render_report(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

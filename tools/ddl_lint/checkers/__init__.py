@@ -1,0 +1,10 @@
+"""Checker registry: importing this package registers every checker."""
+
+from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
+    concurrency,
+    jax_hazards,
+    protocol,
+)
+from tools.ddl_lint.checkers.base import REGISTRY, Checker, register
+
+__all__ = ["REGISTRY", "Checker", "register"]

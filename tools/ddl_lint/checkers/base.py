@@ -1,0 +1,87 @@
+"""Checker base class and registry."""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Type
+
+from tools.ddl_lint.config import LintConfig
+from tools.ddl_lint.context import ModuleContext
+from tools.ddl_lint.findings import Finding
+
+
+class Checker(ast.NodeVisitor):
+    """One check: a NodeVisitor producing findings for a single code.
+
+    Subclasses set ``code`` and ``summary`` and report via
+    :meth:`report`.  The runner instantiates a fresh checker per module,
+    so instance state is module-scoped.
+    """
+
+    code: str = ""
+    summary: str = ""
+
+    def __init__(self, ctx: ModuleContext, config: LintConfig):
+        self.ctx = ctx
+        self.config = config
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        self.visit(self.ctx.tree)
+        return self.findings
+
+    def report(self, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(
+                path=self.ctx.path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0) + 1,
+                code=self.code,
+                message=message,
+            )
+        )
+
+
+class LoopDepthChecker(Checker):
+    """Checker base that tracks lexical loop depth (``self._loop_depth``).
+
+    A nested function/lambda def resets the depth: its body runs per
+    call, not per iteration of the enclosing loop.  Subclasses override
+    ``visit_Call`` (or any other visitor) and consult ``_loop_depth``.
+    """
+
+    def __init__(self, ctx: ModuleContext, config: LintConfig):
+        super().__init__(ctx, config)
+        self._loop_depth = 0
+
+    def _visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+
+    def visit_FunctionDef(self, node: ast.AST) -> None:
+        saved, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+#: code -> checker class, populated by @register.
+REGISTRY: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if not cls.code:
+        raise ValueError(f"{cls.__name__} has no code")
+    if cls.code in REGISTRY:
+        raise ValueError(f"duplicate checker code {cls.code}")
+    REGISTRY[cls.code] = cls
+    return cls
+
+
+def checker_for(code: str) -> Callable[..., Checker]:
+    return REGISTRY[code]

@@ -1,0 +1,271 @@
+"""Concurrency and transport-protocol invariants.
+
+The hand-rolled transport layer (shm ring + epoch counters + watchdog)
+re-implements guarantees the reference got for free from OpenMPI; these
+checks encode the invariants its waits, teardown paths, and lock nesting
+must keep (ISSUE 1, PAPER.md §2.4).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Signals a poll loop may legitimately block on forever IF it observes
+#: one of these: a deadline value, a monotonic clock, or a shutdown flag.
+_CLOCK_CALLS = {"monotonic", "perf_counter", "time"}
+_SHUTDOWN_HINTS = {"is_shutdown", "should_abort", "ShutdownRequested"}
+_DEADLINE_NAME_PARTS = ("timeout", "deadline")
+
+
+def _walk_no_defs(root: ast.AST):
+    """Walk a subtree without descending into nested function/class defs."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+@register
+class UnboundedPollLoop(Checker):
+    """DDL004: every sleep-poll loop needs a deadline or shutdown path.
+
+    A ``while True`` that ``time.sleep``-polls with no deadline check and
+    no shutdown observation is exactly the spin the reference's missing
+    timeouts turned into silent cluster-wide hangs: the peer dies and the
+    loop polls forever.  Loops must check a deadline (``timeout``/
+    ``deadline`` value or a monotonic clock) or a shutdown flag
+    (``is_shutdown`` / ``should_abort`` / ``ShutdownRequested``), and
+    must have a reachable exit (``break``/``return``/``raise``).
+    """
+
+    code = "DDL004"
+    summary = "unbounded while-True sleep-poll loop"
+
+    def visit_While(self, node: ast.While) -> None:
+        if isinstance(node.test, ast.Constant) and node.test.value:
+            body_nodes = [
+                n for stmt in node.body for n in _walk_no_defs(stmt)
+            ]
+            if self._sleeps(body_nodes):
+                exits = any(
+                    isinstance(n, (ast.Break, ast.Return, ast.Raise))
+                    for n in body_nodes
+                )
+                bounded = self._observes_deadline_or_shutdown(body_nodes)
+                if not exits or not bounded:
+                    why = (
+                        "no break/return/raise"
+                        if not exits
+                        else "no deadline or shutdown check"
+                    )
+                    self.report(
+                        node,
+                        f"while-True sleep-poll loop with {why}; bound the "
+                        "wait (deadline) and observe shutdown "
+                        "(is_shutdown/should_abort)",
+                    )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _sleeps(nodes: List[ast.AST]) -> bool:
+        for n in nodes:
+            if isinstance(n, ast.Call) and last_segment(n.func) == "sleep":
+                return True
+        return False
+
+    @staticmethod
+    def _observes_deadline_or_shutdown(nodes: List[ast.AST]) -> bool:
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                seg = last_segment(n.func)
+                if seg in _CLOCK_CALLS or seg in _SHUTDOWN_HINTS:
+                    return True
+            elif isinstance(n, (ast.Name, ast.Attribute)):
+                seg = last_segment(n) or ""
+                low = seg.lower()
+                if seg in _SHUTDOWN_HINTS or any(
+                    part in low for part in _DEADLINE_NAME_PARTS
+                ):
+                    return True
+        return False
+
+
+@register
+class SleepOnHotPath(Checker):
+    """DDL005: no ``time.sleep`` inside hot-path classes.
+
+    The consumer (``DistributedDataLoader``) sits between the ring and
+    the accelerator: a sleep there is dead time the device spends idle
+    every window.  Waits belong in the ring primitives (event waits in
+    the native ring), never open-coded on the consumer path.  The class
+    list comes from ``[tool.ddl_lint] hot_path_classes``.
+    """
+
+    code = "DDL005"
+    summary = "time.sleep inside a hot-path class"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if node.name in set(self.config.hot_path_classes):
+            for inner in ast.walk(node):
+                if (
+                    isinstance(inner, ast.Call)
+                    and last_segment(inner.func) == "sleep"
+                ):
+                    self.report(
+                        inner,
+                        f"time.sleep on the {node.name} hot path; push the "
+                        "wait into the ring primitive (bounded, "
+                        "shutdown-observing) instead",
+                    )
+        self.generic_visit(node)
+
+
+@register
+class LockOrder(Checker):
+    """DDL006: lock acquisition must follow the declared hierarchy.
+
+    ``[tool.ddl_lint] lock_order`` declares the repo's hierarchy
+    (outermost first): ``_build_lock`` → ring locks (``_cond``/``_lock``)
+    → ``_sweep_lock``.  A ``with`` that acquires a lock while already
+    holding one *later* in the hierarchy is an inversion — the deadlock
+    only needs a second thread running the compliant order.  Lexical
+    nesting only: cross-function chains are out of scope (keep lock
+    scopes small enough that the lexical check is the real check).
+    """
+
+    code = "DDL006"
+    summary = "lock acquired against the declared lock hierarchy"
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._held: List[tuple] = []  # (rank, name)
+
+    def _rank(self, expr: ast.AST) -> Optional[tuple]:
+        seg = last_segment(expr)
+        # `with lock:` and `with lock.acquire_timeout(..)`-style wrappers
+        if seg is None and isinstance(expr, ast.Call):
+            seg = last_segment(expr.func)
+        order = self.config.lock_order
+        if seg in order:
+            return order.index(seg), seg
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            rank = self._rank(item.context_expr)
+            if rank is None:
+                continue
+            worst = max(self._held, default=None)
+            if worst is not None and worst[0] > rank[0]:
+                self.report(
+                    node,
+                    f"acquiring {rank[1]!r} while holding "
+                    f"{worst[1]!r} inverts the declared lock "
+                    f"order ({' -> '.join(self.config.lock_order)})",
+                )
+            self._held.append(rank)
+            acquired.append(rank)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # A nested def's body does not run under the enclosing with.
+        saved, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+_BROAD = {"Exception", "BaseException"}
+_SIGNALS = {"ShutdownRequested", "KeyboardInterrupt", "BaseException"}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Set[str]:
+    t = handler.type
+    if t is None:
+        return {"<bare>"}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return {last_segment(e) or "?" for e in elts}
+
+
+@register
+class SwallowedShutdown(Checker):
+    """DDL007: broad excepts must not swallow shutdown signals.
+
+    ``ShutdownRequested`` is control flow: it is how a blocked producer
+    learns the run is over.  A ``except Exception: pass`` (or log-only
+    handler) on a path that can see it converts clean teardown into a
+    silent hang-until-timeout — the watchdog and connection teardown did
+    this in ~10 places.  A broad handler passes when (a) it re-raises,
+    (b) an earlier handler in the same try catches
+    ``ShutdownRequested``/``KeyboardInterrupt`` (re-raise or handle —
+    either way the signal is not lost by accident), or (c) the except
+    names a narrower type.  ``contextlib.suppress(Exception)`` is the
+    same bug in context-manager clothing.
+    """
+
+    code = "DDL007"
+    summary = "broad except swallows ShutdownRequested/KeyboardInterrupt"
+
+    def visit_Try(self, node: ast.Try) -> None:
+        signal_handled = False
+        for handler in node.handlers:
+            names = _handler_names(handler)
+            broad = "<bare>" in names or names & _BROAD
+            if broad:
+                reraises = any(
+                    isinstance(n, ast.Raise)
+                    for stmt in handler.body
+                    for n in _walk_no_defs(stmt)
+                )
+                # The exemption must come from a DISTINCT earlier handler
+                # (or a re-raise): `except BaseException: pass` naming
+                # the broadest signal itself is the swallow, not the
+                # protection.
+                if not reraises and not signal_handled:
+                    self.report(
+                        handler,
+                        "broad except swallows ShutdownRequested/"
+                        "KeyboardInterrupt; narrow the exception type, or "
+                        "precede with 'except (ShutdownRequested, "
+                        "KeyboardInterrupt): raise'",
+                    )
+            if names & _SIGNALS:
+                signal_handled = True
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            ce = item.context_expr
+            if (
+                isinstance(ce, ast.Call)
+                and last_segment(ce.func) == "suppress"
+                and any(
+                    (last_segment(a) or "") in _BROAD for a in ce.args
+                )
+            ):
+                self.report(
+                    node,
+                    "contextlib.suppress(Exception) swallows "
+                    "ShutdownRequested/KeyboardInterrupt; suppress "
+                    "narrower types",
+                )
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With

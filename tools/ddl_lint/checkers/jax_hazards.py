@@ -1,0 +1,234 @@
+"""JAX tracing hazards: host sync, tracer leaks, RNG reuse, loop re-jit.
+
+These police the class of bug the TPU rebuild is most exposed to
+(PAPER.md §2.4): code that looks fine on eager CPU but silently
+synchronizes, recompiles, or leaks tracers once it runs under ``jax.jit``
+on the device path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from tools.ddl_lint.checkers.base import (
+    Checker,
+    LoopDepthChecker,
+    register,
+)
+from tools.ddl_lint.context import dotted_name, last_segment
+
+#: Calls that force a device→host sync (or host I/O) when traced.
+_HOST_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_HOST_SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready"}
+_HOST_IO_NAMES = {"print", "open", "input", "breakpoint"}
+
+
+@register
+class HostSyncInJit(Checker):
+    """DDL001: no host sync / host I/O inside a jit-traced function.
+
+    ``jax.device_get`` / ``block_until_ready`` / ``.item()`` under trace
+    either fail on tracers or, worse, silently run at trace time against
+    abstract values; ``print``/``open`` execute once at trace time and
+    never again (use ``jax.debug.print`` / ``io_callback``).
+    """
+
+    code = "DDL001"
+    summary = "host sync or host I/O inside a jit/pmap/shard_map function"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.ctx.in_jit(node):
+            hit = self._classify(node)
+            if hit:
+                self.report(
+                    node,
+                    f"{hit} inside a traced function; hoist it out of the "
+                    "jit boundary (or use jax.debug / io_callback)",
+                )
+        self.generic_visit(node)
+
+    def _classify(self, node: ast.Call) -> Optional[str]:
+        dotted = dotted_name(node.func)
+        if dotted in _HOST_SYNC_DOTTED:
+            return f"{dotted}()"
+        if isinstance(node.func, ast.Name) and node.func.id in _HOST_IO_NAMES:
+            return f"{node.func.id}()"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_ATTRS
+            and not node.args
+            and not node.keywords
+        ):
+            return f".{node.func.attr}()"
+        return None
+
+
+def _local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside a function body (params + assignments + loops),
+    excluding bindings inside nested function/class defs."""
+    names: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            names.add(a.arg)
+
+    def collect(stmts) -> None:
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ) and node is not stmt:
+                    continue  # ast.walk still descends; handled below
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Store
+                ):
+                    names.add(node.id)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef)):
+                    names.add(node.name)
+
+    body = getattr(fn, "body", None)
+    if isinstance(body, list):
+        collect(body)
+    return names
+
+
+# NB: no "update" — optax's pure `optimizer.update(grads, state)` would
+# false-positive on every training step; dict.update leaks are instead
+# caught as subscript stores when written idiomatically.
+_MUTATORS = {"append", "extend", "add", "insert", "setdefault"}
+
+
+@register
+class TracerLeakInJit(Checker):
+    """DDL002: no closure/global writes from a jit-traced function.
+
+    A traced function that appends to an outer list, writes a global, or
+    stores into a captured dict leaks *tracers* into post-trace Python —
+    the values are abstract, appear exactly once (at trace time), and go
+    stale across cache hits.
+    """
+
+    code = "DDL002"
+    summary = "write to enclosing scope from a jit-traced function"
+
+    def _check_fn(self, fn: ast.AST) -> None:
+        local = _local_bindings(fn)
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            # Nested defs get their own visit via jit ancestry; their
+            # locals differ, but writes THROUGH them still target this
+            # trace, so keep the walk simple and conservative: only
+            # names provably non-local to the jit function are flagged.
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                self.report(
+                    node,
+                    f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                    f"write from a traced function leaks tracers "
+                    f"({', '.join(node.names)})",
+                )
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id not in local
+                ):
+                    self.report(
+                        node,
+                        f"mutating captured {node.func.value.id!r} "
+                        f"(.{node.func.attr}) from a traced function leaks "
+                        "tracers; return the value instead",
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ) and t.value.id not in local:
+                        self.report(
+                            node,
+                            f"subscript store into captured "
+                            f"{t.value.id!r} from a traced function leaks "
+                            "tracers; return the value instead",
+                        )
+
+    def visit_Module(self, node: ast.Module) -> None:
+        for fn in self.ctx.jit_function_nodes:
+            if not isinstance(fn, ast.Lambda):
+                self._check_fn(fn)
+        # no generic_visit: jit functions are enumerated, not re-walked
+
+
+_PRNG_NAMES = {"PRNGKey", "key"}  # jax.random.PRNGKey / jax.random.key
+
+
+@register
+class ConstantKeyInLoop(LoopDepthChecker):
+    """DDL003: no constant-seed PRNGKey construction inside a loop.
+
+    ``jax.random.PRNGKey(0)`` in a loop yields the *same* randomness
+    every iteration — the classic silent-correctness bug in augmentation
+    and dropout loops.  Split or fold_in a carried key instead.
+    """
+
+    code = "DDL003"
+    summary = "constant-seed PRNGKey constructed inside a loop"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func) or ""
+        seg = dotted.rsplit(".", 1)[-1]
+        if (
+            self._loop_depth > 0
+            and seg in _PRNG_NAMES
+            and ("random" in dotted or seg == "PRNGKey")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            self.report(
+                node,
+                f"{dotted}({node.args[0].value!r}) inside a loop produces "
+                "identical randomness every iteration; split/fold_in a "
+                "carried key",
+            )
+        self.generic_visit(node)
+
+
+@register
+class JitInLoop(LoopDepthChecker):
+    """DDL010: no ``jax.jit`` construction inside a loop body.
+
+    ``jax.jit(f)(x)`` in a loop builds a fresh compilation cache entry
+    owner per iteration — at best redundant dict churn, at worst a
+    recompile every step when closures differ.  Hoist the jitted
+    callable out of the loop.
+    """
+
+    code = "DDL010"
+    summary = "jax.jit constructed inside a loop body"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._loop_depth > 0:
+            seg = last_segment(node.func)
+            if seg in ("jit", "pmap"):
+                dotted = dotted_name(node.func) or seg
+                if seg == "jit" or dotted.startswith("jax."):
+                    self.report(
+                        node,
+                        f"{dotted}(...) inside a loop re-wraps per "
+                        "iteration; hoist the jitted callable out of the "
+                        "loop",
+                    )
+        self.generic_visit(node)

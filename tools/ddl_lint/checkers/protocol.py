@@ -1,0 +1,215 @@
+"""Protocol shape checks: ctypes binding declarations, enum dispatch.
+
+The native ring crosses a C ABI with no type checking at the boundary
+(``csrc/shm_ring.cpp`` via ``ctypes``) and the control plane dispatches
+on message enums; both are places where a silent shape mismatch becomes
+memory corruption or a dropped message rather than a traceback.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import dotted_name, last_segment
+
+
+@register
+class CtypesBindingShape(Checker):
+    """DDL008: every ctypes binding declares both restype and argtypes.
+
+    ctypes defaults ``restype`` to ``c_int`` — a 64-bit pointer return
+    (``ddlr_create``) silently truncates to 32 bits without it — and an
+    undeclared ``argtypes`` lets a Python ``int`` pass where a
+    ``c_uint64`` is expected, reading garbage on the C side.  Void
+    functions declare ``restype = None`` explicitly so the intent is
+    visible and this check can tell "void" from "forgot".  Scoped to
+    modules that call ``ctypes.CDLL``.
+    """
+
+    code = "DDL008"
+    summary = "ctypes binding missing restype or argtypes"
+
+    def run(self):
+        tree = self.ctx.tree
+        uses_cdll = any(
+            isinstance(n, ast.Call)
+            and (dotted_name(n.func) or "").endswith("CDLL")
+            for n in ast.walk(tree)
+        )
+        if not uses_cdll:
+            return self.findings
+        restype: Dict[str, ast.AST] = {}
+        argtypes: Dict[str, ast.AST] = {}
+        lib_vars: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    # lib.fn.restype = ... / lib.fn.argtypes = ...
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr in ("restype", "argtypes")
+                        and isinstance(t.value, ast.Attribute)
+                    ):
+                        fn = t.value.attr
+                        (restype if t.attr == "restype" else argtypes)[
+                            fn
+                        ] = node
+                    # lib = ctypes.CDLL(...)
+                    if (
+                        isinstance(t, ast.Name)
+                        and isinstance(node.value, ast.Call)
+                        and (dotted_name(node.value.func) or "").endswith(
+                            "CDLL"
+                        )
+                    ):
+                        lib_vars.add(t.id)
+        for fn, node in argtypes.items():
+            if fn not in restype:
+                self.report(
+                    node,
+                    f"ctypes binding {fn!r} declares argtypes but no "
+                    "restype (defaults to c_int — truncates 64-bit "
+                    "returns); declare restype, or restype = None for "
+                    "void",
+                )
+        for fn, node in restype.items():
+            if fn not in argtypes:
+                self.report(
+                    node,
+                    f"ctypes binding {fn!r} declares restype but no "
+                    "argtypes; undeclared argtypes skip all argument "
+                    "conversion checking",
+                )
+        declared = set(restype) | set(argtypes)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and self._is_lib_base(node.func.value, lib_vars)
+                and node.func.attr not in declared
+            ):
+                self.report(
+                    node,
+                    f"call to undeclared ctypes function "
+                    f"{node.func.attr!r}; declare restype and argtypes "
+                    "before first use",
+                )
+        return self.findings
+
+    @staticmethod
+    def _is_lib_base(base: ast.AST, lib_vars) -> bool:
+        """Does this expression look like a CDLL handle?
+
+        Covers the direct form (a variable assigned from
+        ``ctypes.CDLL``) and the stored-handle idiom the repo actually
+        uses — ``self._lib = _load_native()`` then
+        ``self._lib.ddlr_*(...)`` — by also matching attribute/name
+        bases whose final segment is a conventional lib-handle name.
+        """
+        seg = last_segment(base)
+        return seg in lib_vars or seg in ("lib", "_lib", "cdll", "_cdll")
+
+
+@register
+class EnumDispatch(Checker):
+    """DDL009: enum dispatch must be exhaustive or carry a default.
+
+    An ``if x is Marker.A / elif x is Marker.B`` chain with no ``else``
+    silently ignores any member added later — the message is *dropped*,
+    not rejected.  Either handle every member or end the chain with an
+    ``else`` (conventionally ``raise ValueError``).  Enum membership is
+    resolved from every Enum class defined in the analyzed file set, so
+    cross-module dispatch (``types.Marker`` handled in ``dataloader``)
+    is covered.
+    """
+
+    code = "DDL009"
+    summary = "non-exhaustive enum dispatch without a default branch"
+
+    def visit_If(self, node: ast.If) -> None:
+        # Only chain heads: an If that is the sole statement of a parent
+        # If's orelse is the `elif` continuation, already examined.
+        parent = self.ctx.parent(node)
+        if isinstance(parent, ast.If) and parent.orelse == [node]:
+            self.generic_visit(node)
+            return
+        enum_name, members, has_else = self._scan_chain(node)
+        if enum_name is not None:
+            universe = self.ctx.project_enums.get(enum_name, set())
+            missing = universe - members
+            if not has_else and missing:
+                self.report(
+                    node,
+                    f"dispatch over {enum_name} handles "
+                    f"{sorted(members)} but not {sorted(missing)} and has "
+                    "no else; unhandled messages are silently dropped",
+                )
+        self.generic_visit(node)
+
+    def _scan_chain(self, node: ast.If):
+        """Follow an if/elif chain of `x is Enum.MEMBER` tests."""
+        enum_name = None
+        members: Set[str] = set()
+        cur = node
+        while True:
+            hit = self._enum_test(cur.test)
+            if hit is None:
+                return None, set(), False
+            name, member = hit
+            if enum_name is None:
+                enum_name = name
+            elif name != enum_name:
+                return None, set(), False  # mixed enums: not a dispatch
+            members.add(member)
+            if len(cur.orelse) == 1 and isinstance(cur.orelse[0], ast.If):
+                cur = cur.orelse[0]
+                continue
+            return enum_name, members, bool(cur.orelse)
+
+    def _enum_test(self, test: ast.AST):
+        """Match ``<expr> is/== EnumName.MEMBER`` against known enums."""
+        if not (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.Eq))
+            and len(test.comparators) == 1
+        ):
+            return None
+        comp = test.comparators[0]
+        if isinstance(comp, ast.Attribute) and isinstance(
+            comp.value, (ast.Name, ast.Attribute)
+        ):
+            cls = last_segment(comp.value)
+            if cls in self.ctx.project_enums:
+                return cls, comp.attr
+        return None
+
+    def visit_Match(self, node: ast.Match) -> None:
+        enum_name = None
+        members: Set[str] = set()
+        has_default = False
+        for case in node.cases:
+            pat = case.pattern
+            if isinstance(pat, ast.MatchAs) and pat.pattern is None:
+                has_default = True
+                continue
+            if isinstance(pat, ast.MatchValue) and isinstance(
+                pat.value, ast.Attribute
+            ):
+                cls = last_segment(pat.value.value)
+                if cls in self.ctx.project_enums:
+                    if enum_name is None:
+                        enum_name = cls
+                    if cls == enum_name:
+                        members.add(pat.value.attr)
+        if enum_name is not None and not has_default:
+            missing = self.ctx.project_enums[enum_name] - members
+            if missing:
+                self.report(
+                    node,
+                    f"match over {enum_name} handles {sorted(members)} "
+                    f"but not {sorted(missing)} and has no wildcard case",
+                )
+        self.generic_visit(node)

@@ -1,0 +1,217 @@
+"""Lint configuration: defaults, ``[tool.ddl_lint]`` loading, path ignores.
+
+The config layer answers three questions for the runner:
+
+- which checks are enabled (``enable`` / ``disable``),
+- which paths get which codes ignored (``per_path_ignores``),
+- checker parameters that are repo policy rather than universal truth
+  (the lock hierarchy, the hot-path class list).
+
+Loading prefers stdlib ``tomllib`` (3.11+); on 3.10 (this container) a
+minimal TOML-subset reader handles the ``[tool.ddl_lint]`` tables, whose
+values are restricted to strings, booleans, and arrays of strings — all of
+which are also valid Python literals.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: Every shipped check code, in numeric order.  ``ALL_CODES`` is the
+#: default ``enable`` set; the registry in ``checkers/`` must stay in sync
+#: (``test_lint.py`` asserts it does).
+ALL_CODES: Tuple[str, ...] = (
+    "DDL001",  # host sync inside jit
+    "DDL002",  # tracer-leaking closure write inside jit
+    "DDL003",  # constant PRNGKey in a loop
+    "DDL004",  # unbounded sleep-poll loop
+    "DDL005",  # time.sleep on a hot-path class
+    "DDL006",  # lock acquisition against the declared hierarchy
+    "DDL007",  # broad except swallows ShutdownRequested/KeyboardInterrupt
+    "DDL008",  # ctypes binding missing restype/argtypes
+    "DDL009",  # non-exhaustive enum dispatch without a default
+    "DDL010",  # jax.jit constructed inside a loop
+)
+
+
+@dataclasses.dataclass
+class LintConfig:
+    enable: List[str] = dataclasses.field(
+        default_factory=lambda: list(ALL_CODES)
+    )
+    disable: List[str] = dataclasses.field(default_factory=list)
+    #: Classes whose methods form a consumer/producer hot path: any
+    #: ``time.sleep`` inside them is DDL005.
+    hot_path_classes: List[str] = dataclasses.field(
+        default_factory=lambda: ["DistributedDataLoader", "DataPusher"]
+    )
+    #: Declared lock hierarchy, outermost first.  A ``with`` acquiring a
+    #: lock while one LATER in this list is held is DDL006.
+    lock_order: List[str] = dataclasses.field(
+        default_factory=lambda: ["_build_lock", "_cond", "_lock", "_sweep_lock"]
+    )
+    #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
+    per_path_ignores: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def enabled_codes(self) -> List[str]:
+        return [c for c in self.enable if c not in set(self.disable)]
+
+    def ignored_for(self, rel_path: str) -> set:
+        rel = rel_path.replace("\\", "/")
+        out: set = set()
+        for prefix, codes in self.per_path_ignores.items():
+            if rel.startswith(prefix.rstrip("/") + "/") or rel == prefix:
+                out.update(codes)
+        return out
+
+
+_SECTION = "tool.ddl_lint"
+
+
+def _parse_toml_subset(text: str) -> Dict[str, Dict[str, object]]:
+    """Parse just enough TOML for ``[tool.ddl_lint]`` tables.
+
+    Handles ``[section]`` headers and ``key = <literal>`` lines where the
+    literal is a (possibly multi-line) array of strings, a quoted string,
+    or a boolean.  Everything outside ``tool.ddl_lint*`` sections is
+    skipped without parsing, so the rest of pyproject.toml may use any
+    TOML feature.
+    """
+    tables: Dict[str, Dict[str, object]] = {}
+    section = None
+    pending_key: Optional[str] = None
+    pending_val = ""
+    for raw in text.splitlines():
+        # Comments may trail any line, including continuation lines of a
+        # multi-line array — strip them (quote-aware) BEFORE joining, or
+        # the first inline comment would comment out the rest of the
+        # joined literal and the key would silently fall back to default.
+        line = _strip_inline_comment(raw).strip()
+        if pending_key is not None:
+            pending_val += " " + line
+            if _literal_complete(pending_val):
+                tables[section][pending_key] = _eval_literal(pending_val)
+                pending_key = None
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^\[([^\]]+)\]$", line)
+        if m:
+            name = m.group(1).strip()
+            if name == _SECTION or name.startswith(_SECTION + "."):
+                section = name
+                tables.setdefault(section, {})
+            else:
+                section = None
+            continue
+        if section is None:
+            continue
+        m = re.match(r"^([A-Za-z0-9_./\"'*-]+)\s*=\s*(.*)$", line)
+        if not m:
+            continue
+        key = m.group(1).strip().strip("\"'")
+        val = m.group(2).strip()
+        if _literal_complete(val):
+            tables[section][key] = _eval_literal(val)
+        else:  # array continues on following lines
+            pending_key, pending_val = key, val
+    return tables
+
+
+def _strip_inline_comment(line: str) -> str:
+    """Drop a trailing ``# ...`` comment, respecting quoted strings."""
+    out = []
+    quote = None
+    for ch in line:
+        if quote is not None:
+            out.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _literal_complete(val: str) -> bool:
+    if val.startswith("["):
+        return val.count("[") == val.count("]")
+    return True
+
+
+def _eval_literal(val: str) -> object:
+    val = val.strip()
+    if val in ("true", "false"):
+        return val == "true"
+    try:
+        return ast.literal_eval(val)
+    except (ValueError, SyntaxError):
+        return val  # bare string; tolerated rather than fatal
+
+
+def _load_tables(pyproject: Path) -> Dict[str, Dict[str, object]]:
+    text = pyproject.read_text()
+    try:
+        import tomllib  # Python 3.11+
+
+        data = tomllib.loads(text)
+        tool = data.get("tool", {}).get("ddl_lint")
+        if tool is None:
+            return {}
+        tables: Dict[str, Dict[str, object]] = {_SECTION: {}}
+        for k, v in tool.items():
+            if isinstance(v, dict):
+                tables[f"{_SECTION}.{k}"] = dict(v)
+            else:
+                tables[_SECTION][k] = v
+        return tables
+    except ModuleNotFoundError:
+        return _parse_toml_subset(text)
+
+
+def find_pyproject(start: Path) -> Optional[Path]:
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for p in (cur, *cur.parents):
+        cand = p / "pyproject.toml"
+        if cand.is_file():
+            return cand
+    return None
+
+
+def load_config(pyproject: Optional[Path]) -> LintConfig:
+    """Build a LintConfig from a pyproject.toml (or defaults if absent)."""
+    cfg = LintConfig()
+    if pyproject is None or not pyproject.is_file():
+        return cfg
+    tables = _load_tables(pyproject)
+    main = tables.get(_SECTION, {})
+
+    def str_list(key: str, cur: List[str]) -> List[str]:
+        v = main.get(key)
+        if isinstance(v, (list, tuple)) and all(isinstance(s, str) for s in v):
+            return list(v)
+        return cur
+
+    cfg.enable = str_list("enable", cfg.enable)
+    cfg.disable = str_list("disable", cfg.disable)
+    cfg.hot_path_classes = str_list("hot_path_classes", cfg.hot_path_classes)
+    cfg.lock_order = str_list("lock_order", cfg.lock_order)
+    ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
+    cfg.per_path_ignores = {
+        str(k): [str(c) for c in v]
+        for k, v in ignores.items()
+        if isinstance(v, (list, tuple))
+    }
+    return cfg

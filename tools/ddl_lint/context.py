@@ -1,0 +1,120 @@
+"""Per-module analysis context shared by every checker.
+
+Framework awareness lives here so individual checkers stay small:
+
+- which function bodies execute under a JAX trace (``jit_function_nodes``):
+  decorator forms (``@jax.jit``, ``@partial(jax.jit, ...)``, ``@pmap``,
+  ``@shard_map``) plus the wrap-after-def idiom (``step = jax.jit(step_fn)``
+  marks ``step_fn``);
+- name resolution helpers (dotted paths for ``ast.Attribute`` chains);
+- the project-wide enum table (collected by the runner's first pass).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+#: Callable names (last dotted segment) that stage a function for XLA
+#: tracing.  ``vmap``/``grad`` transform but do not by themselves stage
+#: host callbacks out; the hazards DDL001/DDL002 police are trace-time
+#: ones, so the staging entry points are what matter.
+JIT_WRAPPER_NAMES = {"jit", "pmap", "shard_map", "xmap"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    """Final attribute/name segment of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression evaluate to a staging transform?
+
+    Matches ``jit`` / ``jax.jit`` / ``pmap`` / ``shard_map`` names and
+    ``functools.partial(jax.jit, ...)`` calls.
+    """
+    seg = last_segment(node)
+    if seg in JIT_WRAPPER_NAMES:
+        return True
+    if isinstance(node, ast.Call) and last_segment(node.func) == "partial":
+        return bool(node.args) and _is_jit_callable(node.args[0])
+    return False
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    path: str  # as reported in findings (repo-relative when possible)
+    source: str
+    tree: ast.Module
+    #: Enum classes defined anywhere in the analyzed file set:
+    #: class name -> member names.
+    project_enums: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def __post_init__(self) -> None:
+        self._attach_parents()
+        self.jit_function_nodes = self._find_jit_functions()
+
+    # -- tree plumbing -----------------------------------------------------
+
+    def _attach_parents(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._ddl_parent = parent  # type: ignore[attr-defined]
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_ddl_parent", None)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    # -- jit awareness -----------------------------------------------------
+
+    def _find_jit_functions(self) -> Set[ast.AST]:
+        """Function defs whose bodies run under trace."""
+        jit_defs: Set[ast.AST] = set()
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                for deco in node.decorator_list:
+                    if _is_jit_callable(deco):
+                        jit_defs.add(node)
+        # wrap-after-def: jax.jit(step_fn) / partial(jax.jit, ...)(step_fn)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) or not _is_jit_callable(node.func):
+                continue
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    jit_defs.update(defs_by_name.get(arg.id, []))
+                elif isinstance(arg, ast.Lambda):
+                    jit_defs.add(arg)
+        return jit_defs
+
+    def in_jit(self, node: ast.AST) -> bool:
+        """Is this node lexically inside a traced function body?"""
+        for anc in self.ancestors(node):
+            if anc in self.jit_function_nodes:
+                return True
+        return False

@@ -1,0 +1,32 @@
+"""Finding records and the `file:line: CODE message` reporter."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint violation, anchored to a source location."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+def render_report(findings: Iterable[Finding]) -> str:
+    """Stable, grep-friendly report: one `path:line:col: CODE msg` per
+    finding, sorted by location, with a trailing count line."""
+    ordered: List[Finding] = sorted(findings)
+    lines = [f.render() for f in ordered]
+    n = len(ordered)
+    lines.append(
+        "ddl-lint: clean" if n == 0 else f"ddl-lint: {n} finding(s)"
+    )
+    return "\n".join(lines)

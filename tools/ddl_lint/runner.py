@@ -1,0 +1,159 @@
+"""File discovery, the two analysis passes, and suppression filtering.
+
+Pass 1 parses every file and collects project-wide facts checkers need
+across module boundaries (today: Enum classes and their members, for
+DDL009).  Pass 2 runs each enabled checker over each module and filters
+findings through inline suppressions and per-path config ignores.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.ddl_lint.checkers import REGISTRY
+from tools.ddl_lint.config import LintConfig, find_pyproject, load_config
+from tools.ddl_lint.context import ModuleContext
+from tools.ddl_lint.findings import Finding
+from tools.ddl_lint.suppress import collect_suppressions, is_suppressed
+
+_SKIP_DIRS = {"__pycache__", ".git", "csrc", ".venv", "node_modules"}
+
+_ENUM_BASES = {"Enum", "IntEnum", "StrEnum", "Flag", "IntFlag"}
+
+
+def discover_files(paths: Sequence[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        # A bad path must be an ERROR, not an empty result: a typo'd or
+        # renamed directory would otherwise turn the gate into a
+        # permanent silent no-op that reports "clean" forever.
+        if not path.exists():
+            raise FileNotFoundError(f"lint path does not exist: {p}")
+        if path.is_file():
+            if path.suffix != ".py":
+                raise ValueError(f"not a Python file: {p}")
+            out.append(path)
+        elif path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    out.append(f)
+    return out
+
+
+def collect_project_enums(
+    trees: Iterable[Tuple[Path, ast.Module]]
+) -> Dict[str, Set[str]]:
+    defs: Dict[str, List[Set[str]]] = {}
+    for _, tree in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(
+                (base.attr if isinstance(base, ast.Attribute) else
+                 getattr(base, "id", None)) in _ENUM_BASES
+                for base in node.bases
+            ):
+                continue
+            members = {
+                t.id
+                for stmt in node.body
+                if isinstance(stmt, ast.Assign)
+                for t in stmt.targets
+                if isinstance(t, ast.Name) and not t.id.startswith("_")
+            }
+            if members:
+                defs.setdefault(node.name, []).append(members)
+    # Dispatch sites reference enums by bare class name, so membership is
+    # keyed the same way — but two UNRELATED same-named enums in
+    # different files would union their members and DDL009 would
+    # false-positive on fully exhaustive dispatches.  A name whose
+    # definitions disagree is ambiguous: drop it from checking entirely
+    # (conservative) rather than guess which one a dispatch means.
+    return {
+        name: sets[0]
+        for name, sets in defs.items()
+        if all(s == sets[0] for s in sets[1:])
+    }
+
+
+def _rel_path(path: Path, root: Optional[Path]) -> str:
+    try:
+        if root is not None:
+            return str(path.resolve().relative_to(root))
+    except ValueError:
+        pass
+    return str(path)
+
+
+def run_paths(
+    paths: Sequence[str],
+    config: Optional[LintConfig] = None,
+    config_file: Optional[str] = None,
+) -> List[Finding]:
+    """Lint ``paths`` (files or directories) and return sorted findings.
+
+    ``config=None`` loads ``[tool.ddl_lint]`` from the nearest
+    pyproject.toml above the first path (or cwd); pass an explicit
+    :class:`LintConfig` to bypass file config entirely (the self-test
+    fixtures do, so repo policy cannot mask a regressed checker).
+    """
+    files = discover_files(paths)
+    root: Optional[Path] = None
+    if config is None:
+        if config_file:
+            pyproject = Path(config_file)
+            # Same fail-loud rule as lint paths: a typo'd --config
+            # silently replacing repo policy with built-in defaults
+            # would look exactly like a clean, configured run.
+            if not pyproject.is_file():
+                raise FileNotFoundError(
+                    f"config file does not exist: {config_file}"
+                )
+        else:
+            pyproject = find_pyproject(
+                Path(paths[0]) if paths else Path.cwd()
+            )
+        config = load_config(pyproject)
+        if pyproject is not None:
+            root = pyproject.parent.resolve()
+    parse_failures: List[Finding] = []
+    parsed: List[Tuple[Path, str, ast.Module]] = []
+    for f in files:
+        try:
+            source = f.read_text(encoding="utf-8")
+            parsed.append((f, source, ast.parse(source)))
+        except (OSError, SyntaxError, ValueError) as e:
+            parse_failures.append(
+                Finding(
+                    path=_rel_path(f, root),
+                    line=getattr(e, "lineno", 1) or 1,
+                    col=1,
+                    code="DDL000",
+                    message=f"cannot analyze: {type(e).__name__}: {e}",
+                )
+            )
+    project_enums = collect_project_enums(
+        (f, tree) for f, _, tree in parsed
+    )
+    enabled = [c for c in config.enabled_codes() if c in REGISTRY]
+    findings: List[Finding] = list(parse_failures)
+    for f, source, tree in parsed:
+        rel = _rel_path(f, root)
+        ctx = ModuleContext(
+            path=rel, source=source, tree=tree, project_enums=project_enums
+        )
+        per_line, file_wide = collect_suppressions(source)
+        path_ignored = config.ignored_for(rel)
+        for code in enabled:
+            if code in path_ignored:
+                continue
+            checker = REGISTRY[code](ctx, config)
+            for finding in checker.run():
+                if not is_suppressed(
+                    finding.code, finding.line, per_line, file_wide
+                ):
+                    findings.append(finding)
+    return sorted(findings)

@@ -1,0 +1,81 @@
+"""Inline suppression comments: ``# ddl-lint: disable=DDL0xx[,DDL0yy]``.
+
+A suppression applies to findings reported on the same physical line as
+the comment.  ``disable=all`` silences every check on that line.  A
+module-level pragma — the comment alone on a line among the first ten
+lines of the file, before any code — silences the codes for the whole
+file (used sparingly; prefer per-path config ignores for blanket policy).
+"""
+
+from __future__ import annotations
+
+import io
+import tokenize
+from typing import Dict, Set, Tuple
+
+_TAG = "ddl-lint:"
+
+
+def _parse_comment(comment: str) -> Set[str]:
+    """Extract suppressed codes from one comment string, or empty set."""
+    text = comment.lstrip("#").strip()
+    if not text.startswith(_TAG):
+        return set()
+    rest = text[len(_TAG):].strip()
+    if not rest.startswith("disable"):
+        return set()
+    _, _, codes = rest.partition("=")
+    # Tolerate trailing prose or a second `#` comment after the codes:
+    # only comma-separated code tokens immediately after `=` count.
+    codes = codes.split("#", 1)[0]
+    out: Set[str] = set()
+    for chunk in codes.split(","):
+        tok = chunk.strip().split()[:1]
+        if tok:
+            out.add(tok[0])
+    return out
+
+
+def collect_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Map line -> suppressed codes, plus file-wide suppressed codes.
+
+    Tokenizes rather than regexes so that ``ddl-lint: disable=...`` inside
+    a string literal is not treated as a pragma.
+    """
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    saw_code = False
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return per_line, file_wide
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            codes = _parse_comment(tok.string)
+            if not codes:
+                continue
+            line = tok.start[0]
+            per_line.setdefault(line, set()).update(codes)
+            if not saw_code and line <= 10:
+                file_wide.update(codes)
+        elif tok.type not in (
+            tokenize.NL,
+            tokenize.NEWLINE,
+            tokenize.ENCODING,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+        ):
+            saw_code = True
+    return per_line, file_wide
+
+
+def is_suppressed(
+    code: str,
+    line: int,
+    per_line: Dict[int, Set[str]],
+    file_wide: Set[str],
+) -> bool:
+    for pool in (file_wide, per_line.get(line, set())):
+        if code in pool or "all" in pool:
+            return True
+    return False
