@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast
+.PHONY: lint test test-fast bench-smoke check
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -17,3 +17,11 @@ test:
 test-fast:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_transport.py \
 	    tests/test_py_ring.py tests/test_lint.py -q
+
+# Ingest bench at tiny CPU geometry: asserts the JSON line parses and
+# carries the staged-ingest extras (tools/bench_smoke.py).
+bench-smoke:
+	$(PY) tools/bench_smoke.py
+
+# The one-shot local gate: static analysis + bench JSON contract.
+check: lint bench-smoke

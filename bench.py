@@ -48,10 +48,12 @@ sys.path.insert(0, REPO)
 import numpy as np
 
 # -- ingest workload geometry -------------------------------------------------
-N_DATA = 8192  # samples per window
-N_VALUES = 256  # f32 features per sample -> 8 MiB windows
-BATCH = 2048
-EPOCHS_MEASURED = 24
+# Env-overridable so `make bench-smoke` can run the full pipeline with a
+# tiny geometry on CPU (defaults are the published bench shape).
+N_DATA = int(os.environ.get("DDL_BENCH_NDATA", "8192"))  # samples/window
+N_VALUES = int(os.environ.get("DDL_BENCH_NVALUES", "256"))  # f32/sample
+BATCH = int(os.environ.get("DDL_BENCH_BATCH", "2048"))
+EPOCHS_MEASURED = int(os.environ.get("DDL_BENCH_EPOCHS", "24"))
 N_PRODUCERS = 2
 
 # -- backend selection --------------------------------------------------------
@@ -305,6 +307,7 @@ def _run_ingest(
     mode: str = "thread",
     use_prefetch: bool = False,
     link_bytes_per_sec: float = 0.0,
+    staged: bool | None = None,
 ):
     """Returns (samples/sec, north-star metric dict) for one config.
 
@@ -315,7 +318,9 @@ def _run_ingest(
     measured analysis in docs/PERF_NOTES.md); compare the two only where
     ``nproc > n_producers``.  ``use_prefetch`` drains each window via
     ``loader.prefetch()`` (depth-2 lookahead) instead of plain
-    ``__getitem__`` iteration.
+    ``__getitem__`` iteration.  ``staged`` pins the ingest discipline per
+    run (None = the DDL_TPU_STAGED env default) — the bench publishes
+    staged vs inline side by side.
     """
     import jax
 
@@ -332,6 +337,7 @@ def _run_ingest(
         loader = DistributedDataLoader(
             _make_producer(), batch_size=BATCH, connection=env.connection,
             n_epochs=n_epochs, output="jax", metrics=metrics,
+            staged=staged,
         )
         t0 = None
         samples = 0
@@ -992,8 +998,39 @@ def main() -> None:
                         north_star.get("bandwidth_utilization", 0.0), 4
                     ),
                 )
+                # Staged-engine observability for the headline run: where
+                # the engine spent time and whether the pool recycled
+                # (ddl_tpu.staging; zeros when DDL_TPU_STAGED=0).
+                result["staging"] = {
+                    "stage_copy_s": round(north_star["stage_copy_s"], 4),
+                    "transfer_s": round(north_star["transfer_s"], 4),
+                    "stall_s": round(north_star["stall_s"], 4),
+                    "pool_hits": north_star["pool_hits"],
+                    "pool_misses": north_star["pool_misses"],
+                    "queue_depth_max": north_star["queue_depth_max"],
+                }
             except Exception as e:  # noqa: BLE001 - must emit JSON regardless
                 errors["ingest"] = f"{type(e).__name__}: {e}"
+            try:
+                # The SAME config over the inline path (DDL_TPU_STAGED=0
+                # equivalent): the staged-vs-inline ablation — the delta
+                # is the engine's win (pooled buffers + off-thread
+                # copy/dispatch + early slot release).
+                inline, ns_inline = _ingest_best(
+                    nslots=2, n_producers=N_PRODUCERS,
+                    sync_every_batch=False,
+                    use_prefetch=True, staged=False,
+                )
+                result["ingest_inline"] = {
+                    "samples_per_sec": round(inline, 1),
+                    "stall_fraction": round(ns_inline["stall_fraction"], 4),
+                }
+                if result["value"]:
+                    result["staged_vs_inline"] = round(
+                        result["value"] / inline, 3
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors["ingest_inline"] = f"{type(e).__name__}: {e}"
             try:
                 # Same pipeline without the prefetch lookahead: the delta
                 # IS the prefetch win (VERDICT r2 item 5 asked for

@@ -1,0 +1,32 @@
+"""JAX version compatibility shims.
+
+The repo targets jax>=0.8 (top-level ``jax.shard_map`` with the
+``check_vma`` kwarg) but must also run on 0.4.x attaches where the same
+transform lives at ``jax.experimental.shard_map.shard_map`` and the kwarg
+is spelled ``check_rep``.  Every shard_map call site imports from here so
+the probe runs once and the call signature stays the modern one.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:  # jax >= 0.8: top-level export, `check_vma` kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, `check_rep` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(
+    f: Any, *, mesh: Any, in_specs: Any, out_specs: Any,
+    check_vma: bool = True,
+) -> Any:
+    """``jax.shard_map`` with the modern signature on any supported jax."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

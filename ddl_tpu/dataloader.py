@@ -66,6 +66,7 @@ class DistributedDataLoader:
         sharding: Any = None,
         metrics: Optional[Metrics] = None,
         timeout_s: float = 300.0,
+        staged: Optional[bool] = None,
     ):
         if output not in ("torch", "numpy", "jax"):
             raise ValueError(f"output must be torch|numpy|jax, got {output!r}")
@@ -84,11 +85,18 @@ class DistributedDataLoader:
         self._stream_token: Optional[object] = None  # active windows() stream
         self._finalized = False
         self._ingestor = None
+        # Staged windows whose ring slots were released early (copy done)
+        # but which no stream has yielded yet — an abandoned stream's
+        # lookahead survives here, so the next stream serves it instead
+        # of losing it (the break-resume contract, kept under staging).
+        self._staged_orphans: "list" = []
         if output == "jax":
             from ddl_tpu.ingest import DeviceIngestor
 
+            # ``staged=None`` defers to the DDL_TPU_STAGED env gate.
             self._ingestor = DeviceIngestor(
-                device=device, sharding=sharding, metrics=self.metrics
+                device=device, sharding=sharding, metrics=self.metrics,
+                staged=staged,
             )
 
         # -- handshake -----------------------------------------------------
@@ -209,9 +217,12 @@ class DistributedDataLoader:
         VERDICT r2 item 5 wired this into the training path).
 
         Reads ahead *within the current window*: all ``len(self)`` batches
-        of an epoch live in one window, and the ingestor copies each column
-        out of the slot at enqueue time, so lookahead never outlives the
-        slot.  ``mark()`` stays the caller's job, exactly as with plain
+        of an epoch live in one window, and each batch is copied out of
+        the slot before the window is released — at enqueue time on the
+        inline path, and no later than the slot-release barrier
+        (``TransferExecutor.flush_copies`` in ``_release_current``) on
+        the staged path — so lookahead never outlives the slot.
+        ``mark()`` stays the caller's job, exactly as with plain
         iteration.
         """
         if self._ingestor is None:
@@ -224,19 +235,37 @@ class DistributedDataLoader:
             for idx in range(self._lens[self._target]):
                 yield self._host_batch(idx)
 
+        # Staged ingestors enqueue slot views to the background executor
+        # (copy + dispatch off-thread) and pop ready device tuples; the
+        # put fn serves inline ingestors AND the staged adaptive direct
+        # mode (pooled, dispatch now) on hosts where the worker starves.
+        # PrefetchIterator itself gates `transfer` on ingestor.staged.
         return PrefetchIterator(
             host_iter(), self._ingestor, depth,
             put=lambda b: self._ingestor.put_batch(b, splits),
+            transfer=self._ingestor.batch_transfer_fn(splits),
         )
 
     def windows(self, lookahead: int = 1):
         """Stream whole windows into HBM, one per epoch (``output="jax"``).
 
-        The zero-copy ingest path: each window's transfer sources the ring
-        slot directly (no host memcpy anywhere between producer fill and
-        HBM), the slot is released only once the transfer has completed,
-        and the next window's transfer streams while the caller's compute
-        on the current one runs.  This is the TPU analog of the
+        Two ingest disciplines, selected by the ``DDL_TPU_STAGED`` gate
+        and the target platform (``DeviceIngestor.stream_staged``):
+
+        - **Staged** (default on accelerators; forced by
+          ``staged=True``): the background executor copies each window
+          slot→pooled-staging-buffer and dispatches its transfer
+          off-thread; the SLOT is released back to the producer as soon
+          as the staging copy completes — one host memcpy of hold time
+          instead of the whole H2D transfer, so producers refill sooner
+          and the same ``nslots`` sustains a deeper in-flight pipeline.
+        - **Inline** (``DDL_TPU_STAGED=0``, and the default on the CPU
+          client): each window's transfer sources the ring slot directly
+          (no host memcpy anywhere between producer fill and HBM) and
+          the slot is released only once the transfer has completed.
+
+        Either way the next window's transfer streams while the caller's
+        compute on the current one runs.  This is the TPU analog of the
         reference's zero-copy shared-window reads
         (reference ``mpi_dataloader.py:192-193``) extended across the
         host→device boundary.
@@ -270,9 +299,22 @@ class DistributedDataLoader:
         from ddl_tpu.exceptions import StallTimeoutError
         from ddl_tpu.profiling import annotate
 
+        # Staged engine: the window is copied slot→pooled-staging-buffer
+        # by the background executor, and the SLOT is released as soon as
+        # that copy completes — the producer refills while the H2D
+        # transfer (sourcing the staging buffer, not the slot) is still
+        # in flight.  Inline (DDL_TPU_STAGED=0, and the default on the
+        # CPU client, where the stream is zero-copy — see
+        # DeviceIngestor.stream_staged): the transfer sources the slot
+        # directly and the slot is held until the bytes are on device.
+        engine = (
+            self._ingestor.engine() if self._ingestor.stream_staged else None
+        )
+
         held: collections.Counter = collections.Counter()
-        # FIFO of (slot, target, dev_array, samples) with transfers in
-        # flight; at most 1 + lookahead entries.
+        # FIFO of [slot, target, payload, samples, slot_released] with
+        # transfers in flight; at most 1 + lookahead entries.  payload is
+        # a device array (inline) or a StagedTransfer handle (staged).
         pending: collections.deque = collections.deque()
         # GENERATOR-LOCAL rotation cursor.  ``self._target`` stays the
         # authoritative next-UNSERVED pointer and only advances when a
@@ -325,28 +367,82 @@ class DistributedDataLoader:
                 bpw, self.batch_size, *self.shapes[target][1:]
             )
             # Byte accounting is deferred to finish(): counting bytes at
-            # completion keeps ingest.bytes and consumer.samples covering
+            # yield keeps ingest.bytes and consumer.samples covering
             # identical windows over any measurement span (dispatch leads
-            # completion by the lookahead depth).
-            dev = self._ingestor.put_window(window, defer_metrics=True)
+            # the yield by the lookahead depth).
+            if engine is not None:
+                ingestor = self._ingestor
+                payload = engine.submit(
+                    window, lambda buf: (ingestor._transfer(buf),) * 2
+                )
+            else:
+                payload = self._ingestor.put_window(
+                    window, defer_metrics=True
+                )
             held[target] += 1
             cursor = (cursor + 1) % self.n_producers
-            return (slot, target, dev, served)
+            return [slot, target, payload, served, False]
+
+        def release_early():
+            """Staged mode: hand back the slots of every pending window
+            whose staging copy has completed — in pending (FIFO) order,
+            stopping at the first incomplete copy so per-ring release
+            order stays FIFO.  This is what shrinks slot-hold time from
+            'whole H2D transfer' to 'one host memcpy': the producer can
+            refill while the transfer is still crossing the link.
+
+            A released-but-unyielded window's data lives only in its
+            staging buffer, so it is recorded on the LOADER
+            (``_staged_orphans``): if this stream is abandoned, the next
+            stream inherits and serves it — the break-resume contract
+            survives early release."""
+            for entry in pending:
+                slot, target, payload, _served, released = entry
+                if released:
+                    continue
+                if not payload.copy_done.is_set():
+                    break
+                self.connection.rings[target].release(slot)
+                held[target] -= 1
+                entry[4] = True
+                self._staged_orphans.append(entry)
 
         def finish(entry):
-            slot, target, dev, served = entry
-            # The slot stays ours until the bytes are on device; only then
-            # may the producer overwrite it.
-            jax.block_until_ready(dev)
+            slot, target, payload, served, released = entry
+            if engine is not None:
+                # Wait only for the staging copy + dispatch (the slot's
+                # last reader), not the whole transfer — the device value
+                # is an async future exactly like the batch path's.
+                # Work-stealing: an unstarted job runs inline here.
+                dev = engine.executor.complete(payload, self.timeout_s)
+            else:
+                dev = payload
+                # The slot stays ours until the bytes are on device; only
+                # then may the producer overwrite it.
+                jax.block_until_ready(dev)
             self.metrics.incr("ingest.bytes", float(dev.nbytes))
             self.metrics.incr("ingest.windows")
             self.metrics.incr("consumer.windows")
             self.metrics.incr("consumer.samples", served)
-            self.connection.rings[target].release(slot)
-            held[target] -= 1
+            if not released:
+                self.connection.rings[target].release(slot)
+                held[target] -= 1
+            elif self._staged_orphans and self._staged_orphans[0] is entry:
+                # Yielded after its early release: no longer an orphan.
+                self._staged_orphans.pop(0)
             # This window is now SERVED: commit the rotation.
             self._target = (target + 1) % self.n_producers
             return dev
+
+        # Inherit a superseded/abandoned stream's early-released windows:
+        # their slots are gone from the ring (data lives in staging
+        # buffers / in flight to HBM) and they are, by FIFO construction,
+        # exactly the next unserved windows in rotation order — serve
+        # them first, then continue acquiring after them.
+        for entry in self._staged_orphans:
+            pending.append(entry)
+        if pending:
+            cursor = (pending[-1][1] + 1) % self.n_producers
 
         # Yield-bounded up front: the generator serves exactly the
         # epochs left, so exhausting it eagerly (e.g. list()) before
@@ -358,6 +454,11 @@ class DistributedDataLoader:
                 break
             if not pending:
                 pending.append(start_one(self.timeout_s))
+            if engine is not None:
+                # Free completed-copy slots BEFORE deepening: an early
+                # release lowers held[cursor], so the same nslots admits
+                # a deeper in-flight pipeline.
+                release_early()
             # Deepen the pipeline up to `lookahead` extra windows, each
             # a non-blocking try: the first not-yet-committed (or
             # capacity-exhausted) window ends the deepening round.
@@ -367,6 +468,10 @@ class DistributedDataLoader:
                 and not self._finalized
                 and held[cursor]
                 < self.connection.rings[cursor].nslots
+                # A full executor queue would park start_one inside
+                # submit's backpressure wait — deepening is lookahead,
+                # never a place to block.
+                and (engine is None or engine.executor.has_capacity())
             ):
                 # Cheap counter peek first: a not-yet-committed window
                 # must not register a wait event in the stall accounting
@@ -440,6 +545,15 @@ class DistributedDataLoader:
     def _acquire_current(self) -> None:
         from ddl_tpu.profiling import annotate
 
+        if self._staged_orphans:
+            # The next unserved windows live in staging buffers (an
+            # abandoned staged stream released their slots early); the
+            # batch path serves host slot views and cannot reach them.
+            raise RuntimeError(
+                "an abandoned windows() stream left staged windows in "
+                "flight; drain them with a new windows() stream before "
+                "batch iteration"
+            )
         # The annotation makes window-wait stalls visible on the profiler
         # timeline next to the XLA ops (SURVEY §5.1 TPU-native tracing).
         with annotate("ddl.window_acquire"), self.metrics.timed(
@@ -457,6 +571,13 @@ class DistributedDataLoader:
         pre-checkpoint run consumed puts the pipeline at the exact data
         position where it stopped (one window per epoch — Q7 semantics)."""
         for _ in range(n_windows):
+            if self._staged_orphans:
+                # Early-released staged window: already off the ring;
+                # discarding it is dropping the handle.
+                self._staged_orphans.pop(0)
+                self._advance_to_next_producer()
+                self.metrics.incr("consumer.windows_skipped")
+                continue
             self._acquire_current()
             self._release_current()
             self._advance_to_next_producer()
@@ -464,6 +585,14 @@ class DistributedDataLoader:
 
     def _release_current(self) -> None:
         if self._cur_slot is not None:
+            if self._ingestor is not None and self._ingestor._engine is not None:
+                # Slot-safety barrier: a staged prefetch may still hold
+                # queued jobs whose sources VIEW this window (a mid-epoch
+                # break abandons lookahead batches before their copies
+                # ran).  Their staging copies must land before the
+                # producer may overwrite the slot.  O(1) when all copies
+                # already completed — the steady-state case.
+                self._ingestor._engine.executor.flush_copies()
             self._ring().release(self._cur_slot)
             self._cur_slot = None
             self._cur_array = None
@@ -475,6 +604,11 @@ class DistributedDataLoader:
             return
         self._finalized = True
         self._release_current()
+        if self._ingestor is not None:
+            # Stop the staging executor BEFORE the rings go away: pending
+            # jobs error with ShutdownRequested instead of racing teardown,
+            # and completed staging buffers flush back to their pool.
+            self._ingestor.close()
         self.connection.shutdown_operation()
         self.connection.finalize()
         logger.debug("consumer: shutdown complete after epoch %d", self._epoch)

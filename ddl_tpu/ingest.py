@@ -17,11 +17,13 @@ TPU the HBM hop is mandatory, so hiding it is a core feature
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ddl_tpu.observability import Metrics, metrics as default_metrics
+from ddl_tpu.staging import StagedTransfer, staged_enabled
 
 
 class DeviceIngestor:
@@ -30,6 +32,14 @@ class DeviceIngestor:
     With ``sharding`` set (a ``jax.sharding.Sharding``), batches land
     sharded across the mesh — the data-parallel ingest path.  Otherwise
     they land on ``device`` (default: first local device).
+
+    ``staged`` (default: the ``DDL_TPU_STAGED`` env gate, on) routes
+    staging copies through a recycled-buffer pool and, for the lookahead
+    consumers (:class:`PrefetchIterator`, ``DistributedDataLoader.
+    windows``), through a background copy/transfer executor
+    (:mod:`ddl_tpu.staging`).  ``staged=False`` is the inline escape
+    hatch: fresh ``copy=True`` staging on the caller thread, exactly the
+    pre-engine behavior.
     """
 
     def __init__(
@@ -37,6 +47,7 @@ class DeviceIngestor:
         device: Any = None,
         sharding: Any = None,
         metrics: Optional[Metrics] = None,
+        staged: Optional[bool] = None,
     ):
         import jax
 
@@ -46,6 +57,65 @@ class DeviceIngestor:
         if sharding is None and device is None:
             self.device = jax.local_devices()[0]
         self.metrics = metrics or default_metrics()
+        self.staged = staged_enabled(staged)
+        #: Explicit constructor intent (None = env default) — the window
+        #: stream distinguishes "forced on" from "default on" (below).
+        self._staged_arg = staged
+        self._engine: Any = None
+
+    @property
+    def stream_staged(self) -> bool:
+        """Should the WINDOW STREAM route through the staging engine?
+
+        The batch paths always staged a host copy, so pooling/offloading
+        them is strictly-no-worse everywhere.  The stream is different:
+        inline ``put_window`` is the ZERO-COPY path (transfer straight
+        from the ring slot), so staging it adds a whole host memcpy per
+        window.  That trade buys early slot release — worth it where the
+        transfer is a genuine DMA the slot would otherwise sit acquired
+        behind (accelerators), and a pure loss on the CPU client, which
+        can alias host buffers into "device" arrays (measured ~2x slower
+        staged).  Default: staged on accelerators, inline on CPU;
+        ``staged=True`` passed explicitly forces the engine everywhere
+        (tests, experiments).
+        """
+        if not self.staged:
+            return False
+        if self._staged_arg is True:
+            return True
+        return self._target_platform() != "cpu"
+
+    # -- staging engine ----------------------------------------------------
+
+    def engine(self):
+        """The lazily-built staging engine (pool + background executor).
+
+        Built on first use so host-output loaders and ``staged=False``
+        ingestors never pay for a worker thread.  Buffer-recycling
+        safety against CPU zero-copy puts is checked per transfer by the
+        pool itself (see :func:`ddl_tpu.staging._may_alias`).
+        """
+        if self._engine is None:
+            from ddl_tpu.staging import StagedIngestEngine
+
+            self._engine = StagedIngestEngine(metrics=self.metrics)
+        return self._engine
+
+    def close(self) -> None:
+        """Stop the background executor and flush pooled buffers."""
+        if self._engine is not None:
+            self._engine.close()
+
+    def _stage(self, arr: np.ndarray) -> np.ndarray:
+        """Copy ``arr`` into a pooled staging buffer (timed)."""
+        pool = self.engine().pool
+        buf = pool.acquire(arr.shape, arr.dtype)
+        t0 = time.perf_counter()
+        np.copyto(buf, arr, casting="no")
+        self.metrics.add_time(
+            "ingest.stage_copy", time.perf_counter() - t0
+        )
+        return buf
 
     def put(self, cols: Sequence[np.ndarray]) -> Tuple[Any, ...]:
         """Transfer a tuple of column arrays; returns JAX arrays.
@@ -56,16 +126,33 @@ class DeviceIngestor:
         slot is released back to the producer, so an explicit copy is
         mandatory (``ascontiguousarray`` would pass an already-contiguous
         slot view through uncopied and the producer would overwrite it
-        mid-transfer).
+        mid-transfer).  Staged mode stages into recycled pool buffers;
+        inline mode allocates fresh.
         """
         from ddl_tpu.profiling import annotate
 
         target = self.sharding if self.sharding is not None else self.device
         with annotate("ddl.ingest_put"):
-            out = tuple(
-                self._jax.device_put(np.array(c, copy=True), target)
-                for c in cols
-            )
+            if self.staged:
+                pool = self.engine().pool
+                out = []
+                for c in cols:
+                    buf = self._stage(c)
+                    dev = self._jax.device_put(buf, target)
+                    pool.recycle_when_ready(buf, dev)
+                    out.append(dev)
+                out = tuple(out)
+                pool.sweep()
+            else:
+                # The inline escape hatch (DDL_TPU_STAGED=0) IS the
+                # per-batch fresh copy — pragma'd, not pooled.
+                out = tuple(
+                    self._jax.device_put(
+                        np.array(c, copy=True),  # ddl-lint: disable=DDL011
+                        target,
+                    )
+                    for c in cols
+                )
         self.metrics.incr(
             "ingest.bytes", float(sum(int(c.nbytes) for c in cols))
         )
@@ -86,14 +173,33 @@ class DeviceIngestor:
         from ddl_tpu.profiling import annotate
 
         with annotate("ddl.ingest_put"):
-            dev = self._transfer(np.array(batch, copy=True))
+            if self.staged:
+                pool = self.engine().pool
+                buf = self._stage(batch)
+                dev = self._transfer(buf)
+                pool.recycle_when_ready(buf, dev)
+                pool.sweep()
+            else:
+                # Inline escape hatch copy (DDL_TPU_STAGED=0).
+                dev = self._transfer(
+                    np.array(batch, copy=True)  # ddl-lint: disable=DDL011
+                )
         self.metrics.incr("ingest.bytes", float(batch.nbytes))
         self.metrics.incr("ingest.batches")
-        out, off = [], 0
-        for w in splits:
-            out.append(dev[:, off : off + w])
-            off += w
-        return tuple(out)
+        return _device_split(dev, splits)
+
+    def batch_transfer_fn(self, splits: Sequence[int]):
+        """A :data:`~ddl_tpu.staging.TransferFn` running this ingestor's
+        single-transfer batch put from an already-staged buffer — what
+        the background executor runs after its slot→staging copy."""
+
+        def transfer(buf: np.ndarray):
+            dev = self._transfer(buf)
+            self.metrics.incr("ingest.bytes", float(buf.nbytes))
+            self.metrics.incr("ingest.batches")
+            return _device_split(dev, splits), dev
+
+        return transfer
 
     def _transfer(self, arr: np.ndarray) -> Any:
         """One host→device transfer honouring the multihost case: with
@@ -219,6 +325,15 @@ def north_star_report(
     # accounting in DistributedDataLoader.windows).
     report = dict(m.rates())
     report["windows"] = m.counter("consumer.windows")
+    # Staged-ingest observability (ddl_tpu.staging): where the engine's
+    # time went (staging memcpy, observed transfer spans, consumer pop
+    # stalls) and whether the buffer pool is actually recycling.
+    report["stage_copy_s"] = m.timer("ingest.stage_copy").total_s
+    report["transfer_s"] = m.timer("ingest.transfer").total_s
+    report["stall_s"] = m.timer("ingest.stall").total_s
+    report["pool_hits"] = m.counter("staging.pool_hits")
+    report["pool_misses"] = m.counter("staging.pool_misses")
+    report["queue_depth_max"] = m.gauge("staging.queue_depth.max")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (
@@ -232,7 +347,30 @@ class PrefetchIterator:
 
     The standard TPU input recipe: while step k computes, batch k+1 is
     already crossing PCIe/DMA into HBM.
+
+    Two operating modes:
+
+    - **Staged** (``transfer`` given and the ingestor is staged): host
+      batches are *enqueued* to the background executor, which stages
+      them into pooled buffers and dispatches the transfers off-thread —
+      ``__next__`` never copies; it only pops ready device values (pop
+      wait time accumulates into ``ingest.stall``).  Offload is
+      ADAPTIVE: when the worker demonstrably loses every claim to the
+      consumer's work-stealing (a GIL/core-saturated host, where
+      per-batch handoffs cost without buying overlap), fills switch to
+      direct pooled puts — dispatch-now, recycled buffers — and
+      periodically re-probe the executor in case cores free up.
+    - **Inline** (default): each fill calls ``put`` on the caller thread
+      — the pre-engine behavior, and the path for tuple-shaped host
+      batches the single-buffer executor does not model.
     """
+
+    #: Consecutive consumer-stolen jobs before concluding the worker is
+    #: starved, and the direct-put span to run before probing it again.
+    #: Each probe miss costs one handoff's ceremony (~ms on a saturated
+    #: host), so conclude fast and re-probe sparsely.
+    PROBE_MISSES = 2
+    DIRECT_SPAN = 256
 
     def __init__(
         self,
@@ -240,12 +378,19 @@ class PrefetchIterator:
         ingestor: DeviceIngestor,
         depth: int = 2,
         put: Any = None,
+        transfer: Any = None,
     ):
-        """``put`` overrides the transfer call (default ``ingestor.put``)
-        — e.g. a bound ``put_batch`` for single-transfer column batches."""
+        """``put`` overrides the inline transfer call (default
+        ``ingestor.put``) — e.g. a bound ``put_batch`` for
+        single-transfer column batches.  ``transfer`` (a staged
+        :data:`~ddl_tpu.staging.TransferFn`, e.g. from
+        ``ingestor.batch_transfer_fn``) selects staged mode instead;
+        staged direct-mode fills use ``put``, so pass both for the
+        adaptive fallback to stay on the pooled path."""
         self._it = iter(it)
         self._ingestor = ingestor
         self._put = put or ingestor.put
+        self._transfer = transfer if ingestor.staged else None
         self._depth = max(1, depth)
         self._queue: collections.deque = collections.deque()
 
@@ -253,12 +398,48 @@ class PrefetchIterator:
         return self
 
     def __next__(self) -> Any:
+        engine = (
+            self._ingestor.engine() if self._transfer is not None else None
+        )
         while len(self._queue) < self._depth:
             try:
                 host_batch = next(self._it)
             except StopIteration:
                 break
-            self._queue.append(self._put(host_batch))
+            if engine is not None and engine.direct_left == 0:
+                self._queue.append(
+                    engine.submit(host_batch, self._transfer)
+                )
+            else:
+                if engine is not None:
+                    engine.direct_left -= 1
+                self._queue.append(self._put(host_batch))
         if not self._queue:
             raise StopIteration
-        return self._queue.popleft()
+        head = self._queue.popleft()
+        if isinstance(head, StagedTransfer):
+            # Work-stealing pop: an unstarted head job runs inline here
+            # (never slower than the inline path); a worker-claimed one
+            # is a genuine wait, counted as ingest.stall.
+            value = engine.executor.complete(head)
+            if head.worker_executed:
+                engine.stolen_streak = 0
+            else:
+                engine.stolen_streak += 1
+                if engine.stolen_streak >= self.PROBE_MISSES:
+                    # The worker lost PROBE_MISSES claims in a row: it is
+                    # starved for CPU and each handoff is pure overhead.
+                    # Run direct pooled puts for a span, then probe again.
+                    engine.stolen_streak = 0
+                    engine.direct_left = self.DIRECT_SPAN
+            return value
+        return head
+
+
+def _device_split(dev: Any, splits: Sequence[int]) -> Tuple[Any, ...]:
+    """Column-split a transferred (B, sum(splits)) batch ON DEVICE."""
+    out, off = [], 0
+    for w in splits:
+        out.append(dev[:, off : off + w])
+        off += w
+    return tuple(out)

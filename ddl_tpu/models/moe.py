@@ -341,7 +341,7 @@ def _routed_mlp(
             else None
         )
         if bax or sax or tax:
-            from jax import shard_map
+            from ddl_tpu._compat import shard_map
 
             token_axes = tuple(a for a in (bax, sax) if a)
             ff_specs = {

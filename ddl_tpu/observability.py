@@ -40,11 +40,25 @@ class Metrics:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._timers: Dict[str, Timer] = collections.defaultdict(Timer)
+        self._gauges: Dict[str, float] = {}
         self._t0 = time.perf_counter()
 
     def incr(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Point-in-time level (queue depth, pool size).  The high-water
+        mark rides along as ``<name>.max`` so a burst between snapshots
+        is still visible in the bench JSON."""
+        with self._lock:
+            self._gauges[name] = value
+            peak = self._gauges.get(f"{name}.max", value)
+            self._gauges[f"{name}.max"] = max(peak, value)
+
+    def gauge(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
 
     def add_time(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -69,6 +83,7 @@ class Metrics:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._gauges.clear()
             self._t0 = time.perf_counter()
 
     def snapshot(self) -> Dict[str, float]:
@@ -78,6 +93,7 @@ class Metrics:
             for k, t in self._timers.items():
                 out[f"{k}.total_s"] = t.total_s
                 out[f"{k}.count"] = float(t.count)
+            out.update(self._gauges)
             out["elapsed_s"] = time.perf_counter() - self._t0
             return out
 

@@ -265,7 +265,7 @@ def pipeline_apply(
         f"stacked params have {S} stages but mesh {axis}={mesh.shape[axis]}"
     )
 
-    from jax import shard_map
+    from ddl_tpu._compat import shard_map
 
     if stage_param_specs is None:
         param_specs = jax.tree.map(lambda _: P(axis), stacked_params)

@@ -268,12 +268,15 @@ class Trainer:
         epoch_losses: List[float],
         window_hook: Any = None,
         hook_state: Any = None,
+        stream_lookahead: int = 1,
     ) -> FitResult:
         """One multistep scan per streamed window (see ``fit`` docstring).
 
         The per-epoch loss read-back is deferred by one window so the
         host sync of scan k never blocks the enqueue of scan k+1 or the
-        stream of window k+2.
+        stream of window k+2.  With the staged engine (default), each
+        window's ring slot is released once its staging copy lands, so
+        producers refill while transfers and scans overlap.
         """
         from ddl_tpu import Marker
         from ddl_tpu.parallel.train import make_multistep
@@ -297,7 +300,7 @@ class Trainer:
 
         pending = None
         epoch = start_epoch
-        for win in loader.windows():
+        for win in loader.windows(lookahead=stream_lookahead):
             if window_hook is not None:
                 win = window_hook(win)
             state, losses = multi_for(win.shape[0])(
@@ -345,6 +348,7 @@ class Trainer:
         prefetch_depth: int = 2,
         window_stream: Optional[bool] = None,
         window_hook: Any = None,
+        stream_lookahead: int = 1,
         config: Any = None,
     ) -> FitResult:
         """Run the full producer/consumer training job; returns FitResult.
@@ -374,6 +378,11 @@ class Trainer:
         cross-instance ``DeviceGlobalShuffler`` exchange (which, unlike
         the producer-side host exchange, composes with elastic respawn:
         no producer carries exchange state).  Must be shape-preserving.
+
+        ``stream_lookahead`` (window-stream mode only) deepens the window
+        stream's in-flight pipeline (``DistributedDataLoader.windows``'s
+        ``lookahead``); with the staged ingest engine early slot release
+        lets the same ``nslots`` sustain the deeper pipeline.
 
         Under PROCESS/MULTIHOST modes call this from under
         ``if __name__ == "__main__":`` (multiprocessing spawn re-imports
@@ -514,6 +523,7 @@ class Trainer:
                     return trainer._fit_windows(
                         loader, state, start_epoch, n_epochs, epoch_losses,
                         window_hook=window_hook, hook_state=hook_state,
+                        stream_lookahead=stream_lookahead,
                     )
                 finally:
                     if wd is not None:

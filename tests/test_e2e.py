@@ -249,6 +249,7 @@ class TestMixedWindowProperty:
         contract under hypothesis-chosen geometries (the serving state
         machine gained an epoch-boundary guard; this explores its
         space)."""
+        pytest.importorskip("hypothesis")  # test extra; skip if absent
         from hypothesis import given, settings, strategies as st
 
         @settings(max_examples=10, deadline=None)

@@ -181,6 +181,9 @@ class TestNorthStarReport:
         assert set(r) == {
             "samples_per_sec", "stall_fraction", "ingest_bytes_per_sec",
             "windows", "elapsed_s",
+            # staged-ingest extras (ddl_tpu.staging)
+            "stage_copy_s", "transfer_s", "stall_s",
+            "pool_hits", "pool_misses", "queue_depth_max",
         }
         assert r["samples_per_sec"] > 0
 
@@ -293,7 +296,10 @@ class TestLoaderPrefetch:
         is yielded, window k+1 must already be acquired — a recording
         proxy over the single producer's ring observes TWO concurrently
         held slots, and the lookahead acquisition precedes the previous
-        slot's release."""
+        slot's release.  Runs INLINE (staged=False): early slot release
+        is the staged engine's whole point and deliberately breaks the
+        held-until-transfer-complete property asserted here; the staged
+        counterpart lives in tests/test_staging.py."""
         import time
 
         class RecordingRing:
@@ -325,7 +331,7 @@ class TestLoaderPrefetch:
         def main(env):
             loader = DistributedDataLoader(
                 SeqProducer(), batch_size=8, connection=env.connection,
-                n_epochs=4, output="jax",
+                n_epochs=4, output="jax", staged=False,
             )
             rec = RecordingRing(env.connection.rings[0])
             env.connection.rings[0] = rec
@@ -438,7 +444,10 @@ class TestLoaderPrefetch:
     def test_windows_deep_lookahead(self):
         """lookahead > 1 genuinely deepens the pipeline (not capped at
         one): with nslots=4 and lookahead=3 the consumer holds more than
-        two slots at once mid-stream."""
+        two slots at once mid-stream.  Inline mode (staged=False): the
+        staged engine releases slots at copy-completion, so held-count
+        depth is asserted on the path that holds slots for the whole
+        transfer."""
         import time
 
         class HeldCounter:
@@ -467,7 +476,7 @@ class TestLoaderPrefetch:
         def main(env):
             loader = DistributedDataLoader(
                 SeqProducer(), batch_size=8, connection=env.connection,
-                n_epochs=8, output="jax",
+                n_epochs=8, output="jax", staged=False,
             )
             rec = HeldCounter(env.connection.rings[0])
             env.connection.rings[0] = rec

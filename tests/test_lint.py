@@ -124,6 +124,14 @@ VIOLATIONS = {
                 out.append(jax.jit(f)(b))   # re-wrap per iteration
             return out
     """,
+    "DDL011": """
+        import numpy as np
+
+        class DeviceIngestor:
+            def put_batch(self, batch, splits):
+                staged = np.array(batch, copy=True)  # fresh per-batch copy
+                return self._transfer(staged)
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -214,6 +222,19 @@ CLEAN = {
                 return "d"
             elif m is Msg.EOF:
                 return "e"
+    """,
+    "DDL011": """
+        import numpy as np
+
+        class DeviceIngestor:
+            def put_batch(self, batch, splits):
+                buf = self._pool.acquire(batch.shape, batch.dtype)
+                np.copyto(buf, batch)          # pooled staging: sanctioned
+                self.inp.zeros_count += 0      # "np" substring, not numpy
+                return self._transfer(self.inp.zeros(0) or buf)
+
+        def host_side_prep(batch):
+            return np.array(batch, copy=True)  # not a hot-path function
     """,
 }
 

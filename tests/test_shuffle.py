@@ -6,6 +6,11 @@ import time
 
 import numpy as np
 import pytest
+
+# The property tests below need hypothesis (a test extra, pyproject
+# [test]); without it, skip this module cleanly instead of erroring the
+# whole collection.
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from ddl_tpu.exceptions import DDLError

@@ -1,0 +1,437 @@
+"""Staged-ingest engine tests: StagingPool accounting, TransferExecutor
+work-stealing/shutdown, staged windows() (early slot release + orphan
+stash), and staged-vs-inline stream equivalence.
+
+Pool/executor halves run WITHOUT jax (fake device values implementing
+``is_ready``/``addressable_shards``), so the engine's concurrency
+contract is testable in microseconds; the loader-level halves force
+``staged=True`` (the CPU default keeps the zero-copy stream inline —
+``DeviceIngestor.stream_staged``).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddl_tpu import (
+    DataProducerOnInitReturn,
+    DistributedDataLoader,
+    Marker,
+    ProducerFunctionSkeleton,
+    distributed_dataloader,
+)
+from ddl_tpu.exceptions import ShutdownRequested
+from ddl_tpu.observability import Metrics
+from ddl_tpu.staging import (
+    StagingPool,
+    TransferExecutor,
+    staged_enabled,
+)
+
+
+class FakeDev:
+    """Device-value stand-in: ready immediately, aliases nothing."""
+
+    def __init__(self, ready=True, alias_buf=None):
+        self._ready = ready
+        self._alias_buf = alias_buf
+
+    def is_ready(self):
+        return self._ready
+
+    @property
+    def addressable_shards(self):
+        if self._alias_buf is None:
+            return []
+        outer = self
+
+        class _Shard:
+            @property
+            def data(self):
+                class _Buf:
+                    def unsafe_buffer_pointer(_s):
+                        return outer._alias_buf.ctypes.data
+
+                return _Buf()
+
+        return [_Shard()]
+
+
+class TestStagingPool:
+    def test_miss_then_reuse_hit(self):
+        m = Metrics()
+        pool = StagingPool(metrics=m)
+        a = pool.acquire((4, 4), np.float32)
+        assert m.counter("staging.pool_misses") == 1
+        dev = FakeDev()
+        pool.recycle_when_ready(a, dev)
+        pool.recycle_when_ready(pool.acquire((4, 4), np.float32), FakeDev())
+        assert pool.sweep() == 2
+        b = pool.acquire((4, 4), np.float32)
+        assert m.counter("staging.pool_hits") == 1
+        assert b is a or b.shape == (4, 4)  # recycled from the freelist
+        # different key -> fresh
+        pool.acquire((8,), np.int32)
+        assert m.counter("staging.pool_misses") == 3
+
+    def test_cap_bounds_freelist(self):
+        pool = StagingPool(metrics=Metrics(), max_per_key=2)
+        bufs = [pool.acquire((2,), np.float32) for _ in range(4)]
+        for b in bufs:
+            pool.release(b)
+        assert pool.stats()["free_buffers"] == 2  # excess dropped
+
+    def test_not_ready_defers_until_sweep(self):
+        m = Metrics()
+        pool = StagingPool(metrics=m)
+        a = pool.acquire((4,), np.float32)
+        dev = FakeDev(ready=False)
+        pool.recycle_when_ready(a, dev)
+        pool.recycle_when_ready(pool.acquire((4,), np.float32), dev)
+        assert pool.sweep() == 0  # transfer still in flight
+        dev._ready = True
+        assert pool.sweep() == 2
+        pool.acquire((4,), np.float32)
+        assert m.counter("staging.pool_hits") == 1
+
+    def test_aliased_buffer_is_dropped_not_recycled(self):
+        """A buffer the client zero-copied into the device value must
+        never return to the pool — reuse would corrupt served data."""
+        m = Metrics()
+        pool = StagingPool(metrics=m)
+        a = pool.acquire((4,), np.float32)
+        pool.recycle_when_ready(a, FakeDev(alias_buf=a))
+        pool.recycle_when_ready(pool.acquire((4,), np.float32), FakeDev())
+        pool.sweep(block=True)
+        assert m.counter("staging.pool_alias_drops") == 1
+        assert pool.stats()["free_buffers"] == 1  # only the copied one
+
+
+def _np_transfer(results):
+    """TransferFn without jax: records the staged copy's content."""
+
+    def transfer(buf):
+        out = buf.copy()
+        results.append(out)
+        return out, FakeDev()
+
+    return transfer
+
+
+class TestTransferExecutor:
+    def test_jobs_complete_in_fifo_order(self):
+        m = Metrics()
+        pool = StagingPool(metrics=m)
+        ex = TransferExecutor(pool, metrics=m, max_queue=8)
+        results = []
+        tr = _np_transfer(results)
+        handles = [
+            ex.submit(np.full((4,), i, np.float32), tr) for i in range(6)
+        ]
+        got = [float(ex.complete(h)[0]) for h in handles]
+        assert got == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+        ex.close()
+
+    def test_copy_done_precedes_result(self):
+        """copy_done is the early-slot-release edge: it must be set by
+        the time the value pops (the source is no longer referenced)."""
+        ex = TransferExecutor(StagingPool(metrics=Metrics()),
+                              metrics=Metrics(), max_queue=4)
+        h = ex.submit(np.zeros((2,), np.float32), _np_transfer([]))
+        ex.complete(h)
+        assert h.copy_done.is_set()
+        ex.close()
+
+    def test_shutdown_mid_queue_propagates(self):
+        """close() with queued-but-unclaimed jobs: their handles raise
+        ShutdownRequested (never hang), and later submits refuse."""
+        ex = TransferExecutor(StagingPool(metrics=Metrics()),
+                              metrics=Metrics(), max_queue=4)
+        # One job stays below worker_min_depth (2): guaranteed unclaimed.
+        h = ex.submit(np.zeros((2,), np.float32), _np_transfer([]))
+        ex.close()
+        with pytest.raises(ShutdownRequested):
+            h.result(timeout_s=5)
+        assert h.copy_done.is_set()  # waiters are unblocked, not leaked
+        with pytest.raises(ShutdownRequested):
+            ex.submit(np.zeros((2,), np.float32), _np_transfer([]))
+
+    def test_worker_executes_deep_queue(self):
+        """With depth >= worker_min_depth the background worker takes
+        jobs from the newest end while the consumer steals the oldest."""
+        m = Metrics()
+        ex = TransferExecutor(StagingPool(metrics=m), metrics=m,
+                              max_queue=8)
+        results = []
+        tr = _np_transfer(results)
+        handles = [
+            ex.submit(np.full((4,), i, np.float32), tr) for i in range(4)
+        ]
+        # Give the worker a chance at the tail jobs, then drain.
+        deadline = time.time() + 5
+        while not any(h.ready.is_set() for h in handles[1:]):
+            if time.time() > deadline:
+                break
+            time.sleep(0.01)
+        worker_ran = any(h.ready.is_set() for h in handles[1:])
+        got = [float(ex.complete(h)[0]) for h in handles]
+        assert got == [0.0, 1.0, 2.0, 3.0]
+        ex.close()
+        if not worker_ran:
+            pytest.skip("worker starved for 5s on this host")
+        assert any(h.worker_executed for h in handles[1:])
+
+    def test_max_queue_one_does_not_deadlock(self):
+        """DDL_TPU_STAGING_QUEUE=1: the worker threshold clamps to the
+        queue bound, or the second submit would block forever against a
+        worker whose take-depth is unreachable (review finding)."""
+        ex = TransferExecutor(StagingPool(metrics=Metrics()),
+                              metrics=Metrics(), max_queue=1)
+        results = []
+        tr = _np_transfer(results)
+        for i in range(3):
+            h = ex.submit(np.full((2,), i, np.float32), tr)
+            assert float(ex.complete(h, timeout_s=10)[0]) == float(i)
+        ex.close()
+
+    def test_flush_copies_forces_queued_job_copies(self):
+        """flush_copies is the slot-release barrier: a queued-but-
+        unclaimed job's staging copy must have happened by return, so
+        the caller may safely release the source's ring slot."""
+        ex = TransferExecutor(StagingPool(metrics=Metrics()),
+                              metrics=Metrics(), max_queue=4)
+        results = []
+        src = np.full((4,), 7.0, np.float32)
+        h = ex.submit(src, _np_transfer(results))
+        ex.flush_copies()
+        assert h.copy_done.is_set()
+        src[:] = 0.0  # "producer refill" after release: copy unaffected
+        np.testing.assert_array_equal(results[0], np.full((4,), 7.0))
+        ex.close()
+
+    def test_transfer_error_propagates(self):
+        ex = TransferExecutor(StagingPool(metrics=Metrics()),
+                              metrics=Metrics(), max_queue=4)
+
+        def boom(buf):
+            raise ValueError("bad transfer")
+
+        h = ex.submit(np.zeros((2,), np.float32), boom)
+        with pytest.raises(ValueError, match="bad transfer"):
+            ex.complete(h)
+        ex.close()
+
+
+class TaggedWindowProducer(ProducerFunctionSkeleton):
+    """Each window uniformly tagged producer_idx*1000 + iteration
+    (module-level: picklable for PROCESS mode)."""
+
+    inplace_fill = True
+
+    def on_init(self, producer_idx=0, **kw):
+        self.idx = producer_idx
+        self.iteration = 0
+        return DataProducerOnInitReturn(
+            nData=32, nValues=4, shape=(32, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = self.idx * 1000
+
+    def execute_function(self, my_ary, **kw):
+        self.iteration += 1
+        my_ary[:] = self.idx * 1000 + self.iteration
+
+
+class SeqProducer(ProducerFunctionSkeleton):
+    def on_init(self, producer_idx=0, **kw):
+        return DataProducerOnInitReturn(
+            nData=32, nValues=4, shape=(32, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:, -1] = np.arange(32)
+        my_ary[:, :-1] = np.arange(32)[:, None] * 0.5
+
+
+def _window_tags(n_epochs, lookahead, **loader_kw):
+    @distributed_dataloader(n_producers=2, mode="thread", nslots=4)
+    def main(env):
+        loader = DistributedDataLoader(
+            TaggedWindowProducer(), batch_size=8, connection=env.connection,
+            n_epochs=n_epochs, output="jax", **loader_kw,
+        )
+        tags = []
+        for win in loader.windows(lookahead=lookahead):
+            vals = np.unique(np.asarray(win))
+            assert len(vals) == 1
+            tags.append(float(vals[0]))
+            loader.mark(Marker.END_OF_EPOCH)
+        return tags
+
+    return main()
+
+
+class TestStagedWindows:
+    def test_staged_inline_window_streams_identical(self):
+        """Byte-identical window streams for the same producer seed,
+        staged (forced through the engine) vs inline (DDL_TPU_STAGED=0
+        equivalent)."""
+        staged = _window_tags(6, 2, staged=True)
+        inline = _window_tags(6, 2, staged=False)
+        assert staged == inline == [
+            1001.0, 2001.0, 1002.0, 2002.0, 1003.0, 2003.0,
+        ], (staged, inline)
+
+    def test_staged_prefetch_matches_inline_batches(self):
+        """Per-batch prefetch path: byte-identical batch streams between
+        the staged engine and the inline escape hatch."""
+
+        def run(staged):
+            @distributed_dataloader(n_producers=2, mode="thread")
+            def main(env):
+                loader = DistributedDataLoader(
+                    SeqProducer(), batch_size=8, connection=env.connection,
+                    n_epochs=2, output="jax", staged=staged,
+                )
+                out = []
+                for _ in range(2):
+                    for x, y in loader.prefetch(2):
+                        out.append(
+                            (np.asarray(x).tobytes(), np.asarray(y).tobytes())
+                        )
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+                return out
+
+            return main()
+
+        assert run(True) == run(False)
+
+    def test_staged_break_resume_with_orphan_stash(self):
+        """Early slot release must not lose abandoned lookahead windows:
+        an early-released, never-yielded window survives in the loader's
+        orphan stash and the NEXT stream serves it first (the
+        break-resume contract, kept under staging)."""
+
+        @distributed_dataloader(n_producers=1, mode="thread", nslots=4)
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedWindowProducer(), batch_size=8,
+                connection=env.connection, n_epochs=6, output="jax",
+                staged=True,
+            )
+            # Eager worker: copies of lookahead windows complete in the
+            # background, which is what arms early release.
+            loader._ingestor.engine().executor.worker_min_depth = 1
+            tags = []
+            stream = loader.windows(lookahead=2)
+            tags.append(float(np.unique(np.asarray(next(stream)))[0]))
+            loader.mark(Marker.END_OF_EPOCH)
+            tags.append(float(np.unique(np.asarray(next(stream)))[0]))
+            loader.mark(Marker.END_OF_EPOCH)
+            # Let the background worker finish the lookahead copies so
+            # the next iteration's sweep releases their slots early.
+            time.sleep(1.0)
+            tags.append(float(np.unique(np.asarray(next(stream)))[0]))
+            loader.mark(Marker.END_OF_EPOCH)
+            orphaned = len(loader._staged_orphans)
+            if orphaned:
+                # Batch iteration cannot serve staged device windows.
+                with pytest.raises(RuntimeError, match="staged windows"):
+                    loader._host_batch(0)
+            # Abandon the stream; a fresh one must continue exactly at
+            # the next unserved window, orphans first.
+            for win in loader.windows(lookahead=2):
+                tags.append(float(np.unique(np.asarray(win))[0]))
+                loader.mark(Marker.END_OF_EPOCH)
+            return tags, orphaned
+
+        tags, orphaned = main()
+        assert tags == [
+            1001.0, 1002.0, 1003.0, 1004.0, 1005.0, 1006.0,
+        ], tags
+        if not orphaned:
+            pytest.skip(
+                "worker starved on this host: early release never armed "
+                "(stream correctness still verified above)"
+            )
+
+    def test_shutdown_closes_engine(self):
+        """Loader shutdown stops the executor (pending jobs error, the
+        pool flushes) — nothing hangs or leaks."""
+
+        @distributed_dataloader(n_producers=1, mode="thread", nslots=2)
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedWindowProducer(), batch_size=8,
+                connection=env.connection, n_epochs=6, output="jax",
+                staged=True,
+            )
+            stream = loader.windows(lookahead=1)
+            next(stream)
+            loader.mark(Marker.END_OF_EPOCH)
+            loader.shutdown()
+            engine = loader._ingestor._engine
+            assert engine is not None and engine.executor.closed
+            assert engine.pool.stats()["inflight"] == 0
+
+        main()
+
+
+class TestStagedWindowsPyRing:
+    def test_staged_lookahead_windows_over_forced_py_ring(
+        self, monkeypatch
+    ):
+        """windows(lookahead=2) with staged copies over PROCESS-mode
+        producers forced onto the pure-Python shm ring
+        (DDL_TPU_FORCE_PY_RING=1): the engine's slot views, early
+        releases and drain-ahead acquires compose with the fallback
+        transport exactly as with the native/thread rings."""
+        from ringsupport import TSO
+
+        if not TSO:
+            pytest.skip("cross-process py ring needs TSO")
+        monkeypatch.setenv("DDL_TPU_FORCE_PY_RING", "1")
+
+        @distributed_dataloader(n_producers=2, mode="process", nslots=4)
+        def main(env):
+            loader = DistributedDataLoader(
+                TaggedWindowProducer(), batch_size=8,
+                connection=env.connection, n_epochs=6, output="jax",
+                staged=True,
+            )
+            tags = []
+            for win in loader.windows(lookahead=2):
+                tags.append(float(np.unique(np.asarray(win))[0]))
+                loader.mark(Marker.END_OF_EPOCH)
+            return tags
+
+        assert main() == [
+            1001.0, 2001.0, 1002.0, 2002.0, 1003.0, 2003.0,
+        ]
+
+
+class TestEnvGate:
+    def test_staged_enabled_default_and_override(self, monkeypatch):
+        monkeypatch.delenv("DDL_TPU_STAGED", raising=False)
+        assert staged_enabled() is True
+        assert staged_enabled(False) is False
+        monkeypatch.setenv("DDL_TPU_STAGED", "0")
+        assert staged_enabled() is False
+        assert staged_enabled(True) is True
+
+    def test_cpu_stream_defaults_inline(self):
+        """On the CPU client the window stream stays zero-copy unless
+        staging is forced — put_window's alias hazard plus a pure extra
+        memcpy make the engine a loss there."""
+        from ddl_tpu.ingest import DeviceIngestor
+
+        ing = DeviceIngestor(staged=None)
+        if ing._target_platform() == "cpu":
+            assert ing.staged is True
+            assert ing.stream_staged is False
+        forced = DeviceIngestor(staged=True)
+        assert forced.stream_staged is True

@@ -298,6 +298,7 @@ class TestRingProperty:
     def test_any_schedule_preserves_fifo_and_content(self, kind, monkeypatch):
         # In-process use: TSO-gate bypass scoped to this test.
         monkeypatch.setenv("DDL_TPU_UNSAFE_PY_RING", "1")
+        pytest.importorskip("hypothesis")  # test extra; skip if absent
         from hypothesis import given, settings, strategies as st
 
         @settings(max_examples=20, deadline=None)

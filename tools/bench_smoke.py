@@ -1,0 +1,90 @@
+"""bench-smoke: run the ingest bench at tiny CPU geometry and validate
+its JSON contract.
+
+CI-grade guard for the bench itself (`make bench-smoke` / `make check`):
+the full bench is too slow for per-PR runs, but its JSON line is an
+interface — round 2 shipped a bench whose output silently lost fields.
+This runs `DDL_BENCH_MODE=ingest` with a small window/batch geometry,
+asserts the last stdout line parses as JSON, and asserts the staged-
+ingest extras (`staging.stage_copy_s` etc.) plus the staged-vs-inline
+pair are present.
+
+Exit 0 on success; nonzero with a reason on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Keys the ingest headline must always carry.
+REQUIRED = ("metric", "value", "unit", "platform")
+#: Staged-engine extras (north_star_report staging block).
+REQUIRED_STAGING = (
+    "stage_copy_s", "transfer_s", "stall_s",
+    "pool_hits", "pool_misses", "queue_depth_max",
+)
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("DDL_BENCH_PLATFORM", "cpu")
+    env["DDL_BENCH_MODE"] = "ingest"
+    # Tiny geometry: ~0.5 MiB windows, a few epochs — finishes in ~1 min
+    # on one core while still spanning producers -> rings -> device.
+    env.setdefault("DDL_BENCH_NDATA", "512")
+    env.setdefault("DDL_BENCH_BATCH", "128")
+    env.setdefault("DDL_BENCH_EPOCHS", "4")
+    env.setdefault("DDL_BENCH_STREAM_MIB", "2")
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        print(f"bench-smoke: bench exited rc={proc.returncode}")
+        return 1
+    try:
+        result = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        print(f"bench-smoke: last line is not JSON ({e}): {lines[-1]!r}")
+        return 1
+
+    missing = [k for k in REQUIRED if k not in result]
+    staging = result.get("staging")
+    if not isinstance(staging, dict):
+        missing.append("staging")
+    else:
+        missing += [
+            f"staging.{k}" for k in REQUIRED_STAGING if k not in staging
+        ]
+    if "ingest_inline" not in result and "errors" not in result:
+        missing.append("ingest_inline")
+    if missing:
+        print(json.dumps(result, indent=1))
+        print(f"bench-smoke: missing keys: {missing}")
+        return 1
+    if result.get("value") is None:
+        print(json.dumps(result, indent=1))
+        print("bench-smoke: headline value is null "
+              f"(errors={result.get('errors')})")
+        return 1
+    staged = result["value"]
+    inline = result.get("ingest_inline", {}).get("samples_per_sec")
+    print(
+        "bench-smoke: OK — staged "
+        f"{staged} vs inline {inline} samples/s; staging extras present"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

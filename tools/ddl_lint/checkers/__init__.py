@@ -2,6 +2,7 @@
 
 from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     concurrency,
+    ingest_path,
     jax_hazards,
     protocol,
 )

@@ -1,0 +1,101 @@
+"""Ingest hot-path hazards: per-batch staging copies and allocations.
+
+The staged-ingest engine (``ddl_tpu/staging.py``) exists so the per-batch
+device feed never allocates or copies on the critical path — staging goes
+through recycled pool buffers and the background executor.  A fresh
+``np.array(..., copy=True)`` / ``.copy()`` / ``np.zeros`` reintroduced
+into one of those functions silently re-adds allocator churn at batch
+cadence; this checker makes that a lint failure instead of a perf
+regression hunted months later.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import dotted_name
+
+#: Allocation constructors that mint a fresh per-call buffer.
+_FRESH_ALLOC = {"zeros", "empty", "ones", "full", "zeros_like",
+                "empty_like", "ones_like", "full_like"}
+
+
+@register
+class HotPathStagingCopy(Checker):
+    """DDL011: no fresh staging copies/allocations in ingest hot paths.
+
+    Functions named in ``[tool.ddl_lint] ingest_hot_path_functions``
+    (bare names or ``Class.method``) form the per-batch feed into
+    ``device_put``.  Inside them, flag:
+
+    - ``np.array(..., copy=True)`` — the classic per-batch staging copy
+      the StagingPool replaces,
+    - ``<expr>.copy()`` — same copy, method spelling,
+    - ``np.zeros/empty/ones/full[_like]`` — a fresh buffer allocation
+      per call where a pooled buffer belongs.
+
+    Escape hatch: ``# ddl-lint: disable=DDL011`` with a rationale (the
+    inline ``DDL_TPU_STAGED=0`` fallback is the sanctioned example).
+    """
+
+    code = "DDL011"
+    summary = "fresh staging copy/allocation in an ingest hot path"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_hot(node):
+            self._check_body(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_hot(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "ingest_hot_path_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_body(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if node is fn or not isinstance(node, ast.Call):
+                continue
+            # Nested defs stay in scope on purpose: a closure built in a
+            # hot function runs at the same per-batch cadence.
+            hit = self._classify(node)
+            if hit:
+                self.report(
+                    node,
+                    f"{hit} in ingest hot path "
+                    f"{fn.name}();"  # type: ignore[attr-defined]
+                    " stage through the StagingPool (ddl_tpu/staging.py)"
+                    " or pragma-disable with a rationale",
+                )
+
+    def _classify(self, node: ast.Call) -> Optional[str]:
+        dotted = dotted_name(node.func) or ""
+        seg = dotted.rsplit(".", 1)[-1]
+        if seg == "array" and any(
+            kw.arg == "copy"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in node.keywords
+        ):
+            return f"{dotted}(..., copy=True)"
+        # Anchored to the ROOT segment: a substring test would flag any
+        # attribute chain containing "np" (self.inp.zeros).  Bare names
+        # from `from numpy import zeros` are out of scope — resolving
+        # imports isn't worth the false positives on local helpers.
+        if seg in _FRESH_ALLOC and dotted.split(".", 1)[0] in ("np", "numpy"):
+            return f"{dotted}(...)"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "copy"
+            and not node.args
+            and not node.keywords
+        ):
+            return ".copy()"
+        return None

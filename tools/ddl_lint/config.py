@@ -35,6 +35,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL008",  # ctypes binding missing restype/argtypes
     "DDL009",  # non-exhaustive enum dispatch without a default
     "DDL010",  # jax.jit constructed inside a loop
+    "DDL011",  # fresh staging copy/allocation in an ingest hot path
 )
 
 
@@ -53,6 +54,17 @@ class LintConfig:
     #: lock while one LATER in this list is held is DDL006.
     lock_order: List[str] = dataclasses.field(
         default_factory=lambda: ["_build_lock", "_cond", "_lock", "_sweep_lock"]
+    )
+    #: Functions (bare name or ``Class.method``) forming the per-batch
+    #: ingest feed into ``device_put``: fresh copies/allocations inside
+    #: them are DDL011 (stage through the StagingPool instead).
+    ingest_hot_path_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "DeviceIngestor.put",
+            "DeviceIngestor.put_batch",
+            "PrefetchIterator.__next__",
+            "TransferExecutor._run",
+        ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
     per_path_ignores: Dict[str, List[str]] = dataclasses.field(
@@ -208,6 +220,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     cfg.disable = str_list("disable", cfg.disable)
     cfg.hot_path_classes = str_list("hot_path_classes", cfg.hot_path_classes)
     cfg.lock_order = str_list("lock_order", cfg.lock_order)
+    cfg.ingest_hot_path_functions = str_list(
+        "ingest_hot_path_functions", cfg.ingest_hot_path_functions
+    )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
         str(k): [str(c) for c in v]
