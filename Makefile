@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke check
+.PHONY: lint test test-fast bench-smoke check chaos
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -25,3 +25,8 @@ bench-smoke:
 
 # The one-shot local gate: static analysis + bench JSON contract.
 check: lint bench-smoke
+
+# Chaos suite: deterministic fault matrix + randomized multi-fault soak
+# (includes slow PROCESS-mode spawns; docs/ROBUSTNESS.md).
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q

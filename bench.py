@@ -1009,6 +1009,20 @@ def main() -> None:
                     "pool_misses": north_star["pool_misses"],
                     "queue_depth_max": north_star["queue_depth_max"],
                 }
+                # Robustness observability (docs/ROBUSTNESS.md): all
+                # zeros on a healthy run — a nonzero here in a BENCH_*
+                # trajectory means the run only "passed" by recovering
+                # (replays, respawns, degraded shuffle) and deserves a
+                # look even when throughput held.
+                result["robustness"] = {
+                    "respawns": north_star["respawns"],
+                    "watchdog_failures": north_star["watchdog_failures"],
+                    "corrupt_windows": north_star["corrupt_windows"],
+                    "replays": north_star["replays"],
+                    "shuffle_degraded": north_star["shuffle_degraded"],
+                    "staging_retries": north_star["staging_retries"],
+                    "inline_fallbacks": north_star["inline_fallbacks"],
+                }
             except Exception as e:  # noqa: BLE001 - must emit JSON regardless
                 errors["ingest"] = f"{type(e).__name__}: {e}"
             try:

@@ -24,18 +24,37 @@ torch tensors, or JAX device arrays (device ingest).
 from __future__ import annotations
 
 import logging
+import os
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddl_tpu import integrity
 from ddl_tpu.datasetwrapper import ProducerFunctionSkeleton
-from ddl_tpu.exceptions import DoesNotMatchError, ShutdownRequested
+from ddl_tpu.exceptions import (
+    DoesNotMatchError,
+    IntegrityError,
+    LoaderStateError,
+    ShutdownRequested,
+    StallTimeoutError,
+)
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import ConsumerConnection
 from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer
 from ddl_tpu.utils import for_all_methods, with_logging
 
 logger = logging.getLogger("ddl_tpu")
+
+
+class _CorruptAhead(Exception):
+    """Internal: integrity verification failed on a LOOKAHEAD acquire.
+
+    Held earlier slots make out-of-FIFO quarantine impossible, so the
+    stream stops deepening instead; the corrupt window re-verifies (and
+    enters quarantine-and-replay) when it reaches the head.  Never
+    escapes the loader.
+    """
 
 
 # Rank-tagged DEBUG call tracing on every method, as the reference wrapped
@@ -121,6 +140,21 @@ class DistributedDataLoader:
         # protocol (Q6, reference mpi_dataloader.py:223); rotation has
         # no tokens to mismatch.
         self._lens = [r.batches_per_window for r in replies]
+        # End-to-end integrity (ddl_tpu.integrity): every producer that
+        # advertised header stamping gets drain-time verification; the
+        # quarantine-and-replay budget bounds how often one logical
+        # window may be re-requested before the corruption is declared
+        # unrecoverable.  Replay rewinds the producer function, which is
+        # only sound without cross-instance exchange (peer-contributed
+        # rows are not locally regenerable) — with shuffle active a
+        # corrupt slot escalates straight to IntegrityError.
+        self._integrity = all(getattr(r, "integrity", False) for r in replies)
+        self._shuffle_fraction = global_shuffle_fraction_exchange
+        self._max_replays = int(os.environ.get("DDL_TPU_MAX_REPLAYS", "2"))
+        # Per-target count of DISCARDED ring commits (quarantined slots +
+        # stale in-flight successors dropped while waiting for a replay):
+        # logical window seq = ring.released + held - skew.
+        self._seq_skew = [0] * len(replies)
         # Geometry is per-producer: heterogeneous column layouts are served
         # correctly rather than silently mis-split with producer 0's spec.
         self.splits_per_producer = [tuple(r.splits) for r in replies]
@@ -174,7 +208,7 @@ class DistributedDataLoader:
         if idx < 0 or idx >= self._lens[self._target]:
             raise IndexError(idx)
         if self._finalized:
-            raise RuntimeError("loader is finalized")
+            raise LoaderStateError("loader is finalized")
         if self._cur_array is None:
             self._acquire_current()
         assert self._cur_array is not None
@@ -226,7 +260,7 @@ class DistributedDataLoader:
         iteration.
         """
         if self._ingestor is None:
-            raise RuntimeError("prefetch requires output='jax'")
+            raise LoaderStateError("prefetch requires output='jax'")
         from ddl_tpu.ingest import PrefetchIterator
 
         splits = self.splits_per_producer[self._target]
@@ -291,13 +325,13 @@ class DistributedDataLoader:
         that set ``inplace_fill`` for a fully copy-free pipeline.
         """
         if self._ingestor is None:
-            raise RuntimeError("windows() requires output='jax'")
+            raise LoaderStateError("windows() requires output='jax'")
         import collections
 
         import jax
 
-        from ddl_tpu.exceptions import StallTimeoutError
         from ddl_tpu.profiling import annotate
+        from ddl_tpu.staging import StagedTransfer
 
         # Staged engine: the window is copied slot→pooled-staging-buffer
         # by the background executor, and the SLOT is released as soon as
@@ -338,7 +372,7 @@ class DistributedDataLoader:
 
         def check_live():
             if self._stream_token is not token:
-                raise RuntimeError(
+                raise LoaderStateError(
                     "this windows() stream was superseded by a newer "
                     "windows() call on the same loader; iterate one "
                     "stream at a time"
@@ -348,14 +382,16 @@ class DistributedDataLoader:
             """Acquire the next window at the local cursor, start its
             transfer, advance the cursor.  With ``held[target] > 0`` the
             ring's drain-lookahead primitive acquires PAST the still-held
-            slot (release order stays FIFO)."""
+            slot (release order stays FIFO).  Acquisition is integrity-
+            verified: a corrupt head window is quarantined and replayed
+            before anything is submitted downstream."""
             nonlocal cursor
             target = cursor
             ring = self.connection.rings[target]
             with annotate("ddl.window_acquire"), self.metrics.timed(
                 "consumer.wait"
             ):
-                slot = ring.acquire_drain_ahead(held[target], timeout_s)
+                slot = self._acquire_verified(target, held[target], timeout_s)
             arr = self._slot_array(target, slot)
             # Ragged tail rows (nData not a batch multiple) are unserved,
             # exactly as in batch iteration.  bpw is per-TARGET: mixed
@@ -369,11 +405,28 @@ class DistributedDataLoader:
             # Byte accounting is deferred to finish(): counting bytes at
             # yield keeps ingest.bytes and consumer.samples covering
             # identical windows over any measurement span (dispatch leads
-            # the yield by the lookahead depth).
-            if engine is not None:
+            # the yield by the lookahead depth).  An engine that faulted
+            # (staged transfers exhausted their retry budget) is skipped:
+            # the degradation ladder routes every later window straight
+            # down the sanctioned inline path.
+            if engine is not None and not engine.faulted:
                 ingestor = self._ingestor
+                # Post-copy re-verify (ddl_tpu.integrity): when the
+                # served rows span the whole payload, the committed CRC
+                # also certifies the staging copy — the executor checks
+                # it after its slot→buffer memcpy, catching a producer
+                # overwriting a not-yet-copied slot.
+                expected_crc = None
+                if self._integrity and window.nbytes == int(
+                    ring.slot_payload(slot)
+                ):
+                    expected_crc = integrity.read_header(
+                        ring.slot_view(slot), ring.slot_payload(slot)
+                    ).crc
                 payload = engine.submit(
-                    window, lambda buf: (ingestor._transfer(buf),) * 2
+                    window,
+                    lambda buf: (ingestor._transfer(buf),) * 2,
+                    expected_crc=expected_crc,
                 )
             else:
                 payload = self._ingestor.put_window(
@@ -400,6 +453,12 @@ class DistributedDataLoader:
                 slot, target, payload, _served, released = entry
                 if released:
                     continue
+                if not isinstance(payload, StagedTransfer):
+                    # Inline-fallback window (engine faulted mid-stream):
+                    # its transfer sources the slot directly, so the slot
+                    # is held until finish() — and release order is FIFO,
+                    # so nothing behind it may release early either.
+                    break
                 if not payload.copy_done.is_set():
                     break
                 self.connection.rings[target].release(slot)
@@ -409,12 +468,23 @@ class DistributedDataLoader:
 
         def finish(entry):
             slot, target, payload, served, released = entry
-            if engine is not None:
+            if isinstance(payload, StagedTransfer):
                 # Wait only for the staging copy + dispatch (the slot's
                 # last reader), not the whole transfer — the device value
                 # is an async future exactly like the batch path's.
-                # Work-stealing: an unstarted job runs inline here.
-                dev = engine.executor.complete(payload, self.timeout_s)
+                # Work-stealing: an unstarted job runs inline here.  On
+                # transfer-retry exhaustion the engine salvages the
+                # verified staging copy down the sanctioned inline path
+                # (degradation ladder rung 2 — no loss, no duplicate;
+                # `engine.faulted` routes later windows inline up front).
+                def inline_put(buf):
+                    dev = self._ingestor.put_window(buf, defer_metrics=True)
+                    jax.block_until_ready(dev)
+                    return dev
+
+                dev = engine.complete_or_salvage(
+                    payload, inline_put, self.timeout_s
+                )
             else:
                 dev = payload
                 # The slot stays ours until the bytes are on device; only
@@ -470,8 +540,13 @@ class DistributedDataLoader:
                 < self.connection.rings[cursor].nslots
                 # A full executor queue would park start_one inside
                 # submit's backpressure wait — deepening is lookahead,
-                # never a place to block.
-                and (engine is None or engine.executor.has_capacity())
+                # never a place to block.  A faulted engine routes
+                # inline, so its queue no longer gates deepening.
+                and (
+                    engine is None
+                    or engine.faulted
+                    or engine.executor.has_capacity()
+                )
             ):
                 # Cheap counter peek first: a not-yet-committed window
                 # must not register a wait event in the stall accounting
@@ -484,6 +559,12 @@ class DistributedDataLoader:
                     pending.append(start_one(0.0))
                 except StallTimeoutError:
                     break  # not committed yet; wait at next iter
+                except _CorruptAhead:
+                    # Corrupt window discovered during lookahead: held
+                    # slots forbid out-of-FIFO quarantine, so stop
+                    # deepening — it re-verifies (and replays) when it
+                    # reaches the head at ahead == 0.
+                    break
                 except NotImplementedError:
                     # Ring without drain lookahead (a custom WindowRing
                     # on the base-class fallback): degrade to strict
@@ -542,6 +623,149 @@ class DistributedDataLoader:
             .reshape(self.shapes[target])
         )
 
+    # -- end-to-end integrity (ddl_tpu.integrity) --------------------------
+
+    def _expected_seq(self, target: int, ahead: int) -> int:
+        """Logical window number of the slot ``acquire_drain_ahead(ahead)``
+        returns on ``target``: released count plus lookahead, minus the
+        commits discarded by past quarantine replays."""
+        ring = self.connection.rings[target]
+        return int(ring.stats()["released"]) + ahead - self._seq_skew[target]
+
+    def _verify_slot(
+        self, target: int, slot: int, expect_seq: int
+    ) -> Optional[str]:
+        """Drain-time header check; None when the window is intact."""
+        ring = self.connection.rings[target]
+        return integrity.verify_window(
+            ring.slot_view(slot),
+            ring.slot_payload(slot),
+            expect_seq=expect_seq,
+            expect_producer=target + 1,
+        )
+
+    def _acquire_verified(self, target: int, ahead: int, timeout_s: float):
+        """Acquire the next committed slot on ``target`` and verify its
+        integrity header.  A corrupt head slot (``ahead == 0``) enters
+        quarantine-and-replay; corruption discovered during lookahead
+        deepening (``ahead > 0``) raises :class:`_CorruptAhead` — held
+        slots make out-of-FIFO quarantine impossible, so the caller
+        stops deepening and the window re-verifies when it reaches the
+        head."""
+        ring = self.connection.rings[target]
+        slot = (
+            ring.acquire_drain_ahead(ahead, timeout_s)
+            if ahead
+            else ring.acquire_drain(timeout_s)
+        )
+        if not self._integrity:
+            return slot
+        expect = self._expected_seq(target, ahead)
+        err = self._verify_slot(target, slot, expect)
+        if err is None:
+            return slot
+        if ahead or timeout_s <= 0:
+            # Deferred, NOT counted yet: held slots forbid out-of-FIFO
+            # quarantine, and a non-blocking deepening probe
+            # (timeout_s == 0) must not run a replay wait under a
+            # zero-second budget — either way the same corrupt window
+            # re-verifies when a BLOCKING head acquire reaches it, which
+            # is where it is counted once and replayed under the
+            # loader's real timeout.
+            raise _CorruptAhead(err)
+        self.metrics.incr("integrity.corrupt_windows")
+        return self._quarantine_and_replay(target, expect, err, timeout_s)
+
+    def _quarantine_and_replay(
+        self, target: int, seq: int, err: str, timeout_s: float
+    ) -> int:
+        """The corrupt-slot recovery ladder (docs/ROBUSTNESS.md).
+
+        The head slot of ``target`` failed verification as logical
+        window ``seq``.  Re-request ``seq`` from the producer (which
+        rewinds via the deterministic-replay contract), discard the
+        quarantined slot plus any stale in-flight successors, and serve
+        the re-committed window — byte-identical, exactly once.  Rungs:
+
+        1. up to ``DDL_TPU_MAX_REPLAYS`` replay attempts per window;
+        2. cross-instance exchange active → no local replay is possible
+           → :class:`IntegrityError` immediately;
+        3. budget exhausted (persistent corruption) → IntegrityError.
+
+        The caller's acquired head slot is owned by this method from
+        entry: every discard releases it and acquires the next commit.
+        """
+        ring = self.connection.rings[target]
+        for attempt in range(1, self._max_replays + 1):
+            if self._shuffle_fraction > 0.0:
+                raise IntegrityError(
+                    f"corrupt window {seq} from producer {target + 1} "
+                    f"({err}); not replayable: cross-instance exchange "
+                    "contributed rows no local rewind can regenerate"
+                )
+            logger.error(
+                "ddl_tpu: corrupt window %d from producer %d (%s) — "
+                "quarantined; replay attempt %d/%d",
+                seq, target + 1, err, attempt, self._max_replays,
+            )
+            self.metrics.incr("integrity.replays")
+            self.connection.request_replay(target, seq)
+            deadline = time.monotonic() + max(timeout_s, 1.0)
+            last_request = time.monotonic()
+            reattempt = False
+            while not reattempt:
+                # Discard the head (quarantined or stale) and take the
+                # next commit; the producer is re-committing seq, seq+1,
+                # ... behind us, so this loop is bounded by the in-flight
+                # depth plus one replayed window.
+                ring.release(int(ring.stats()["released"]) % ring.nslots)
+                self._seq_skew[target] += 1
+                while True:
+                    now = time.monotonic()
+                    if now >= deadline:
+                        raise IntegrityError(
+                            f"replayed window {seq} from producer "
+                            f"{target + 1} never arrived within "
+                            f"{timeout_s}s"
+                        )
+                    if now - last_request >= 2.0:
+                        # Re-send periodically: the original request is
+                        # LOST if the producer died (or was respawned —
+                        # fresh channel) before reading it; requests are
+                        # idempotent rewinds, and a respawned replacement
+                        # polls its new channel like any incarnation.
+                        self.connection.request_replay(target, seq)
+                        last_request = now
+                    try:
+                        slot = ring.acquire_drain(
+                            min(2.0, deadline - now)
+                        )
+                        break
+                    except StallTimeoutError:
+                        continue  # wake to re-send, then wait again
+                hdr = integrity.read_header(
+                    ring.slot_view(slot), ring.slot_payload(slot)
+                )
+                if not hdr.valid_magic or hdr.seq != seq:
+                    continue  # stale in-flight successor: discard too
+                err = self._verify_slot(target, slot, seq)
+                if err is None:
+                    # The replayed commit is served (and later released)
+                    # through the normal path — skew already counts
+                    # exactly the discarded commits before it.
+                    logger.warning(
+                        "ddl_tpu: window %d from producer %d recovered "
+                        "by replay", seq, target + 1,
+                    )
+                    return slot
+                # Replayed copy is corrupt AGAIN: burn a replay attempt.
+                self.metrics.incr("integrity.corrupt_windows")
+                reattempt = True
+        raise IntegrityError(
+            f"window {seq} from producer {target + 1} still corrupt "
+            f"after {self._max_replays} replay(s): {err}"
+        )
+
     def _acquire_current(self) -> None:
         from ddl_tpu.profiling import annotate
 
@@ -549,7 +773,7 @@ class DistributedDataLoader:
             # The next unserved windows live in staging buffers (an
             # abandoned staged stream released their slots early); the
             # batch path serves host slot views and cannot reach them.
-            raise RuntimeError(
+            raise LoaderStateError(
                 "an abandoned windows() stream left staged windows in "
                 "flight; drain them with a new windows() stream before "
                 "batch iteration"
@@ -559,7 +783,7 @@ class DistributedDataLoader:
         with annotate("ddl.window_acquire"), self.metrics.timed(
             "consumer.wait"
         ):
-            slot = self._ring().acquire_drain(self.timeout_s)
+            slot = self._acquire_verified(self._target, 0, self.timeout_s)
         self._cur_slot = slot
         self._cur_array = self._slot_array(self._target, slot)
         self.metrics.incr("consumer.windows")

@@ -27,13 +27,16 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from ddl_tpu import integrity
 from ddl_tpu.datasetwrapper import DataProducerOnInitReturn
 from ddl_tpu.exceptions import DoesNotMatchError, ShutdownRequested
+from ddl_tpu.faults import fault_point
 from ddl_tpu.observability import Metrics, metrics as default_metrics
-from ddl_tpu.transport.connection import ProducerConnection
+from ddl_tpu.transport.connection import NOTHING, ProducerConnection
 from ddl_tpu.types import (
     MetaData_Consumer_To_Producer,
     MetaData_Producer_To_Consumer,
+    ReplayRequest,
     RunMode,
     Topology,
     normalize_splits,
@@ -47,10 +50,22 @@ logger = logging.getLogger("ddl_tpu")
 DEFAULT_NSLOTS = 2
 
 
+def _abort_sentinel() -> str:
+    """The consumer's ABORT broadcast string (lazy: env imports this
+    module inside its spawn target, so a top-level import would cycle)."""
+    from ddl_tpu.env import ABORT
+
+    return ABORT
+
+
 # DEBUG call tracing on every method, as the reference did
 # (``for_all_methods(with_logging)``, reference ``datapusher.py:44``);
 # ``_commit_window`` (per-window hot path) stays quiet.
-@for_all_methods(with_logging, exclude=("_commit_window", "_slot_array"))
+@for_all_methods(
+    with_logging,
+    exclude=("_commit_window", "_stamp_and_commit", "_slot_array",
+             "_poll_control"),
+)
 class DataPusher:
     """One producer worker: handshake, then fill windows until shutdown.
 
@@ -77,6 +92,11 @@ class DataPusher:
         self.nslots = nslots
         self.metrics = metrics or default_metrics()
         self._iteration = 0
+
+        # End-to-end window integrity (ddl_tpu.integrity): slots carry a
+        # checksummed trailer header past the payload; the flag rides the
+        # handshake reply so the consumer always agrees on slot layout.
+        self._integrity = integrity.integrity_enabled()
 
         # -- handshake (reference datapusher.py:46-124) --------------------
         meta: MetaData_Consumer_To_Producer = connection.recv_metadata_as_producer()
@@ -152,6 +172,11 @@ class DataPusher:
                     num_exchange=num_exchange,
                     exchange_method=meta.exchange_method,
                 )
+                # Degradation events must land in THIS pipeline's
+                # registry (factories stay picklable, so the registry
+                # cannot ride through them — it is injected post-hoc).
+                if hasattr(self.shuffler, "metrics"):
+                    self.shuffler.metrics = self.metrics
                 if rejoin_ring is not None:
                     # Rejoining a LIVE exchange needs POSITIVE capability:
                     # a replay-capable shuffler (round re-entry over a
@@ -213,8 +238,24 @@ class DataPusher:
                     )
                 self.callbacks.append(self.shuffler)
 
+        # Integrity slots are one trailer header larger than the payload;
+        # geometry (shape/splits/payload) is untouched.
+        slot_bytes = self.window_nbytes + (
+            integrity.HEADER_BYTES if self._integrity else 0
+        )
         if rejoin_ring is not None:
             self.ring = connection.attach_ring(rejoin_ring)
+            if self._integrity and self.ring.slot_bytes < slot_bytes:
+                # The predecessor created this ring without integrity
+                # headroom: the incarnations disagree on DDL_TPU_INTEGRITY
+                # (env drift across a respawn) — fail at handshake rather
+                # than stamping headers over the next slot's payload.
+                raise DoesNotMatchError(
+                    self.ring.slot_bytes,
+                    "surviving ring has no integrity-header headroom; "
+                    "respawned producer must run with the same "
+                    "DDL_TPU_INTEGRITY setting as its predecessor",
+                )
             if self.shuffler is not None and self.ring.nslots < 2:
                 # Checked against the ATTACHED ring's REAL geometry (the
                 # ctor arg may disagree with what the predecessor
@@ -230,7 +271,7 @@ class DataPusher:
                     "read a torn fill",
                 )
         else:
-            self.ring = connection.create_ring(nslots, self.window_nbytes)
+            self.ring = connection.create_ring(nslots, slot_bytes)
         if self.inplace_fill:
             # Zero-copy fill: the user writes straight into ring slots.
             # (On a fresh ring the first slot is free immediately; on a
@@ -246,6 +287,7 @@ class DataPusher:
                 splits=self.splits,
                 batches_per_window=self.batches_per_window,
                 dtype=self.dtype.name,
+                integrity=self._integrity,
             )
         )
 
@@ -256,8 +298,20 @@ class DataPusher:
             # Replay to the predecessor's data position: the ring's
             # committed count IS the number of windows already published
             # (a death between data-write and commit re-publishes that
-            # window — the consumer never saw it).
-            done = int(self.ring.stats()["committed"])
+            # window — the consumer never saw it).  With integrity
+            # headers the LAST COMMITTED SLOT's header is the exact
+            # logical position instead: after a quarantine replay the
+            # raw committed count includes discarded re-commits, so
+            # counting commits would overshoot the data stream.
+            committed = int(self.ring.stats()["committed"])
+            done = committed
+            if self._integrity and committed:
+                hdr = integrity.read_header(
+                    self.ring.slot_view((committed - 1) % self.ring.nslots),
+                    self.window_nbytes,
+                )
+                if hdr.valid_magic:
+                    done = hdr.seq + 1
             if done:
                 execute_callbacks(
                     self.callbacks, "fast_forward", n=done,
@@ -274,7 +328,7 @@ class DataPusher:
                     # producer), so restore the full state from it.
                     np.copyto(
                         self.my_ary,
-                        self._slot_array((done - 1) % self.ring.nslots),
+                        self._slot_array((committed - 1) % self.ring.nslots),
                     )
             if self.shuffler is not None:
                 # Re-enter the exchange schedule at the committed round:
@@ -303,22 +357,111 @@ class DataPusher:
             .reshape(self.shape)
         )
 
+    def _stamp_and_commit(self, slot: int) -> None:
+        """Stamp the integrity trailer (crc + seq + producer) and publish.
+
+        The ``producer.commit`` injection point runs AFTER the header is
+        written, against the payload view — flipped bytes therefore
+        mismatch the committed CRC exactly the way real shared-memory
+        corruption would, and the consumer's drain-time verify catches
+        it (tests/test_faults.py).
+        """
+        view = self.ring.slot_view(slot)
+        if self._integrity:
+            payload = view[: self.window_nbytes]
+            integrity.write_header(
+                view,
+                self.window_nbytes,
+                seq=self._iteration,
+                producer_idx=self.producer_idx,
+                crc=integrity.window_crc(payload),
+            )
+        fault_point(
+            "producer.commit",
+            producer_idx=self.producer_idx,
+            view=view[: self.window_nbytes],
+        )
+        self.ring.commit(slot, self.window_nbytes)
+
     def _commit_window(self) -> None:
         """Publish the filled window and stage the next fill target."""
         if self.inplace_fill:
             # my_ary IS the slot: publish it, then point my_ary at the
             # next free slot for the coming refill.
             assert self._fill_slot is not None
-            self.ring.commit(self._fill_slot, self.window_nbytes)
+            self._stamp_and_commit(self._fill_slot)
         else:
             slot = self.ring.acquire_fill()  # raises ShutdownRequested on stop
             np.copyto(self._slot_array(slot), self.my_ary)
-            self.ring.commit(slot, self.window_nbytes)
+            self._stamp_and_commit(slot)
         self.metrics.incr("producer.windows")
         self.metrics.incr("producer.bytes", self.window_nbytes)
         if self.inplace_fill:
             self._fill_slot = self.ring.acquire_fill()
             self.my_ary = self._slot_array(self._fill_slot)
+
+    def _poll_control(self) -> None:
+        """Drain pending control messages (non-blocking, once per window).
+
+        The channel is idle after the handshake; two message classes can
+        arrive mid-run: :class:`ReplayRequest` (quarantined corrupt slot
+        — rewind and re-commit) and the consumer's ABORT broadcast
+        (treated as shutdown, like the ring flag it accompanies).
+        """
+        while True:
+            msg = self.connection.channel.try_recv()
+            if msg is NOTHING:
+                return
+            if isinstance(msg, ReplayRequest):
+                self._handle_replay(msg.seq)
+            elif isinstance(msg, str) and msg == _abort_sentinel():
+                raise ShutdownRequested("consumer abort broadcast")
+            else:
+                logger.warning(
+                    "producer %d: ignoring unexpected control message %r",
+                    self.producer_idx, type(msg).__name__,
+                )
+
+    def _handle_replay(self, seq: int) -> None:
+        """Rewind the producer function to logical window ``seq`` and
+        resume committing from there — the corrupt-slot re-request path
+        (``ddl_tpu.integrity``).  Same deterministic-replay recipe as a
+        respawned incarnation: ``on_init`` → ``post_init`` →
+        ``fast_forward(seq)``; the consumer discards whatever this
+        producer committed past ``seq`` before the request arrived.
+        """
+        if self.shuffler is not None:
+            # Peer-exchanged lanes are not locally regenerable; the
+            # consumer never requests replay in this configuration
+            # (it raises IntegrityError instead) — refuse rather than
+            # silently desync the exchange schedule.
+            logger.error(
+                "producer %d: ignoring replay request at %d (cross-"
+                "instance exchange active; stream is not locally "
+                "replayable)", self.producer_idx, seq,
+            )
+            return
+        logger.warning(
+            "producer %d: replaying window stream from %d "
+            "(corrupt-slot re-request; was at %d)",
+            self.producer_idx, seq, self._iteration,
+        )
+        self.metrics.incr("producer.replays")
+        execute_callbacks(
+            self.callbacks,
+            "on_init",
+            producer_idx=self.producer_idx,
+            n_producers=self.topology.n_producers,
+            instance_idx=self.topology.instance_idx,
+            n_instances=self.topology.n_instances,
+            batch_size=self.batch_size,
+        )
+        execute_callbacks(self.callbacks, "post_init", my_ary=self.my_ary)
+        if seq:
+            execute_callbacks(
+                self.callbacks, "fast_forward", n=seq, my_ary=self.my_ary
+            )
+        self._iteration = seq
 
     def push_data(self) -> None:
         execute_callbacks(self.callbacks, "on_push_begin")
@@ -326,8 +469,15 @@ class DataPusher:
         try:
             while True:
                 # Order matches the reference loop (datapusher.py:152-166):
-                # exchange across instances, then the user's refill/shuffle,
-                # then hand the window to the consumer.
+                # replay/abort poll, chaos injection point, exchange
+                # across instances, then the user's refill/shuffle, then
+                # hand the window to the consumer.
+                self._poll_control()
+                fault_point(
+                    "producer.fill",
+                    producer_idx=self.producer_idx,
+                    should_abort=self.ring.is_shutdown,
+                )
                 execute_callbacks(
                     self.callbacks,
                     "global_shuffle",

@@ -29,6 +29,7 @@ import threading
 from typing import Any, Callable, List, Optional
 
 from ddl_tpu.exceptions import ShutdownRequested, TransportError
+from ddl_tpu.faults import fault_point
 from ddl_tpu.transport.connection import (
     ConsumerConnection,
     PipeChannel,
@@ -84,6 +85,10 @@ def _producer_main(
     from ddl_tpu.datapusher import DataPusher
 
     try:
+        # Chaos hook: a crash here exercises the handshake-failure
+        # shipping path (the consumer fails fast with a typed error
+        # instead of timing out).
+        fault_point("producer.handshake", producer_idx=producer_idx)
         pusher = DataPusher(
             conn,
             topology,
@@ -357,7 +362,7 @@ def distributed_dataloader(
                 # pre-handshake (ABORT sentinel) or in a ring wait
                 # (shutdown flag). Producers already exited ignore both.
                 workers.abort()
-                workers.join()
+                workers.join(timeout_s=30.0)
             return result
 
         return wrapper

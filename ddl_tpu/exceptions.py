@@ -44,11 +44,46 @@ class ShutdownRequested(DDLError):
     """
 
 
-class StallTimeoutError(TransportError):
+class StallTimeoutError(TransportError, TimeoutError):
     """A blocking wait on the ring exceeded its deadline.
 
     The reference had no deadline at all — a lost peer deadlocked the job
     until the pytest 100 s timeout killed it (reference
     ``tests/test_ddl.py:8``).  Here every wait carries a configurable
     timeout so failure detection is built in.
+
+    Subclasses ``TimeoutError`` too so that EVERY deadline failure on a
+    framework path — ring waits, control recvs, staged-transfer pops —
+    is catchable through one hierarchy (``except StallTimeoutError`` /
+    ``except DDLError``) without breaking callers that guard with the
+    builtin.
     """
+
+
+class IntegrityError(DDLError):
+    """Data failed an end-to-end integrity check.
+
+    Raised when bytes provably changed between producer and consumer:
+    a ring-slot window whose committed checksum no longer matches at
+    drain (and replay could not heal it), a staged copy that diverged
+    from its verified source, or a TFRecord whose framing CRCs fail
+    (``ddl_tpu.readers.iter_tfrecords``).  Always carries enough context
+    (file/offset or ring/window) to locate the corruption.
+    """
+
+
+class InjectedFault(DDLError):
+    """A deliberate failure raised by the fault-injection engine.
+
+    Only ever raised while a :class:`ddl_tpu.faults.FaultPlan` is armed —
+    production paths can neither construct nor observe it.  Distinct
+    from real error types so the chaos suite can tell an injected crash
+    from a genuine one leaking out of the machinery under test.
+    """
+
+
+class LoaderStateError(DDLError, RuntimeError):
+    """The loader was driven from an invalid state (finalized loader,
+    superseded ``windows()`` stream, batch iteration over abandoned
+    staged windows).  Subclasses ``RuntimeError`` for backwards
+    compatibility with callers that guarded on the builtin."""

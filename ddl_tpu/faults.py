@@ -1,0 +1,312 @@
+"""Deterministic fault injection: seeded chaos for the whole pipeline.
+
+PR 1/2 gave the pipeline bounded waits, a respawning watchdog, and
+shutdown propagation — but nothing could *prove* those paths work under
+arbitrary failure timing.  This module is that proof harness: a seeded,
+deterministic fault engine with named injection points threaded through
+the transport rings, staging engine, shuffle exchange, worker set, and
+watchdog.  The chaos suite (``tests/test_faults.py``) arms a
+:class:`FaultPlan` and asserts exactly-once, byte-identical delivery of
+the surviving stream (docs/ROBUSTNESS.md has the full fault matrix).
+
+Design constraints:
+
+- **Zero cost disarmed.**  Every injection point is
+  ``fault_point("site", ...)`` whose disarmed path is a single module
+  attribute read and a ``return`` — no dict build, no lock, no logging.
+  Production binaries keep the points compiled in (the whole value is
+  that the TESTED code path is the SHIPPED code path).
+- **Deterministic.**  A spec fires on the *n*-th matching hit of its
+  site (``at``), for ``count`` consecutive hits, per-producer
+  selectable; corruption bytes come from the plan's seeded RNG.  Same
+  plan + same pipeline ⇒ same faults.
+- **Cross-process.**  ``DDL_TPU_FAULT_PLAN`` carries the JSON-encoded
+  plan across the spawn boundary, so PROCESS-mode producers arm
+  themselves on import exactly like the consumer did.
+
+Injection points shipped today (site — fault kinds that act there):
+
+========================  ====================================================
+``producer.fill``         crash / hang / slowdown / spurious shutdown, at the
+                          top of ``DataPusher.push_data``'s window loop
+``producer.commit``       ring-slot corruption (payload bytes flipped AFTER
+                          the integrity header was written)
+``producer.handshake``    crash during ``_producer_main`` construction
+``ring.fill``/``ring.drain``  spurious shutdown / slowdown inside the ring
+                          wait primitives (all three ring implementations)
+``staging.copy``          staging-copy failure / source corruption
+``staging.transfer``      staged-transfer failure / timeout (delay)
+``shuffle.exchange``      peer loss (partner never posts its half)
+``watchdog.sweep``        spurious shutdown / crash inside ``check_once``
+========================  ====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ddl_tpu.exceptions import DDLError, InjectedFault, ShutdownRequested
+
+
+class FaultKind(enum.Enum):
+    """What happens when a spec fires (see docs/ROBUSTNESS.md matrix)."""
+
+    PRODUCER_CRASH = "producer_crash"
+    PRODUCER_HANG = "producer_hang"
+    PRODUCER_SLOWDOWN = "producer_slowdown"
+    RING_CORRUPTION = "ring_corruption"
+    STAGING_COPY_FAIL = "staging_copy_fail"
+    STAGED_TRANSFER_FAIL = "staged_transfer_fail"
+    STAGED_TRANSFER_TIMEOUT = "staged_transfer_timeout"
+    SHUFFLE_PEER_LOSS = "shuffle_peer_loss"
+    SPURIOUS_SHUTDOWN = "spurious_shutdown"
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scheduled fault.
+
+    ``at`` is 1-based: the spec fires on the ``at``-th matching hit of
+    ``site`` and keeps firing for ``count`` consecutive hits (``count``
+    large ⇒ a persistent fault).  ``producer_idx`` narrows matching to
+    one producer's hits (``None`` matches any, including consumer-side
+    sites that carry no producer).  ``param`` parameterises the action:
+    sleep seconds for hang/slowdown/timeout, corrupted-byte count for
+    ring corruption.
+    """
+
+    site: str
+    kind: FaultKind
+    at: int = 1
+    count: int = 1
+    producer_idx: Optional[int] = None
+    param: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "site": self.site,
+            "kind": self.kind.value,
+            "at": self.at,
+            "count": self.count,
+            "producer_idx": self.producer_idx,
+            "param": self.param,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultSpec":
+        return cls(
+            site=d["site"],
+            kind=FaultKind(d["kind"]),
+            at=int(d.get("at", 1)),
+            count=int(d.get("count", 1)),
+            producer_idx=d.get("producer_idx"),
+            param=float(d.get("param", 0.0)),
+        )
+
+
+class FaultPlan:
+    """A seed plus a schedule of :class:`FaultSpec`\\ s.
+
+    Thread-safe: injection points are hit concurrently from producers,
+    the staging worker, and the consumer; hit counting happens under one
+    lock (only while armed — the disarmed path never reaches it).
+    ``fired`` records ``(site, kind, producer_idx, hit_number)`` per
+    firing, for test introspection.
+    """
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self.fired: List[Tuple[str, str, Optional[int], int]] = []
+        self._lock = threading.Lock()
+        self._hits: Dict[int, int] = {}  # spec index -> matching hits
+        import numpy as np
+
+        self._rng = np.random.default_rng(self.seed)
+
+    # -- (de)serialisation (the spawn-boundary / env-var format) -----------
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "specs": [s.to_dict() for s in self.specs]}
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(
+            [FaultSpec.from_dict(s) for s in d.get("specs", [])],
+            seed=int(d.get("seed", 0)),
+        )
+
+    # -- firing ------------------------------------------------------------
+
+    def fire(
+        self,
+        site: str,
+        producer_idx: Optional[int],
+        view: Any,
+        should_abort: Optional[Callable[[], bool]],
+    ) -> None:
+        due: List[FaultSpec] = []
+        with self._lock:
+            for i, spec in enumerate(self.specs):
+                if spec.site != site:
+                    continue
+                if (
+                    spec.producer_idx is not None
+                    and spec.producer_idx != producer_idx
+                ):
+                    continue
+                n = self._hits.get(i, 0) + 1
+                self._hits[i] = n
+                if spec.at <= n < spec.at + spec.count:
+                    self.fired.append(
+                        (site, spec.kind.value, producer_idx, n)
+                    )
+                    due.append(spec)
+        for spec in due:
+            self._act(spec, view=view, should_abort=should_abort)
+
+    def _act(
+        self,
+        spec: FaultSpec,
+        view: Any,
+        should_abort: Optional[Callable[[], bool]],
+    ) -> None:
+        kind = spec.kind
+        where = f"injected at {spec.site!r}"
+        if kind is FaultKind.PRODUCER_CRASH:
+            raise InjectedFault(f"producer crash {where}")
+        elif kind is FaultKind.SPURIOUS_SHUTDOWN:
+            raise ShutdownRequested(f"spurious shutdown {where}")
+        elif kind is FaultKind.PRODUCER_HANG:
+            # A wedge, not a sleep: hold until the stall budget/shutdown
+            # machinery reacts, observing shutdown so a healed run (or a
+            # clean teardown) is never stranded behind the injection.
+            deadline = time.monotonic() + (spec.param or 3600.0)
+            while time.monotonic() < deadline:
+                if should_abort is not None and should_abort():
+                    raise ShutdownRequested(f"hang aborted {where}")
+                time.sleep(0.05)
+        elif kind in (
+            FaultKind.PRODUCER_SLOWDOWN,
+            FaultKind.STAGED_TRANSFER_TIMEOUT,
+        ):
+            time.sleep(spec.param or 0.2)
+        elif kind is FaultKind.RING_CORRUPTION:
+            if view is None or len(view) == 0:
+                return  # site carries no mutable payload; nothing to flip
+            nbytes = max(1, int(spec.param))
+            with self._lock:
+                offs = self._rng.integers(0, len(view), size=nbytes)
+            for off in offs:
+                view[int(off)] ^= 0xFF
+        elif kind in (
+            FaultKind.STAGING_COPY_FAIL,
+            FaultKind.STAGED_TRANSFER_FAIL,
+        ):
+            raise InjectedFault(f"{kind.value} {where}")
+        elif kind is FaultKind.SHUFFLE_PEER_LOSS:
+            raise DDLError(f"shuffle peer loss {where}")
+        else:  # pragma: no cover - FaultKind is closed above
+            raise ValueError(f"unhandled fault kind {kind!r}")
+
+
+#: The armed plan, or None.  Read unlocked on every injection point —
+#: a single module-attribute load is the entire disarmed cost.
+_ARMED: Optional[FaultPlan] = None
+
+#: Env var carrying a JSON plan across process-spawn boundaries.
+PLAN_ENV = "DDL_TPU_FAULT_PLAN"
+
+
+def fault_point(
+    site: str,
+    producer_idx: Optional[int] = None,
+    view: Any = None,
+    should_abort: Optional[Callable[[], bool]] = None,
+) -> None:
+    """One named injection point.  No-op (one attribute read) unless a
+    plan is armed; with a plan, matching specs act — raising, sleeping,
+    or corrupting ``view`` in place."""
+    plan = _ARMED
+    if plan is None:
+        return
+    plan.fire(site, producer_idx, view, should_abort)
+
+
+def arm(plan: Optional[FaultPlan], export: bool = False) -> Optional[FaultPlan]:
+    """Arm ``plan`` process-wide (``None`` disarms).  ``export=True``
+    additionally publishes it to :data:`PLAN_ENV` so PROCESS-mode
+    producers spawned afterwards arm themselves on import.  Returns the
+    previously armed plan."""
+    global _ARMED
+    prev = _ARMED
+    _ARMED = plan
+    if export:
+        if plan is None:
+            os.environ.pop(PLAN_ENV, None)
+        else:
+            os.environ[PLAN_ENV] = plan.to_json()
+    return prev
+
+
+def armed_plan() -> Optional[FaultPlan]:
+    return _ARMED
+
+
+class armed:
+    """Context manager: arm a plan for a scoped chaos run.
+
+    ::
+
+        plan = FaultPlan([FaultSpec("producer.fill", FaultKind.PRODUCER_CRASH, at=3)])
+        with faults.armed(plan, export=True):
+            run_pipeline()
+        assert plan.fired
+
+    Restores the previous plan (and the env var) on exit, even when the
+    pipeline under test raises.
+    """
+
+    def __init__(self, plan: FaultPlan, export: bool = False):
+        self.plan = plan
+        self.export = export
+        self._prev: Optional[FaultPlan] = None
+        self._prev_env: Optional[str] = None
+
+    def __enter__(self) -> FaultPlan:
+        self._prev_env = os.environ.get(PLAN_ENV)
+        self._prev = arm(self.plan, export=self.export)
+        return self.plan
+
+    def __exit__(self, *exc: Any) -> None:
+        arm(self._prev)
+        if self.export:
+            if self._prev_env is None:
+                os.environ.pop(PLAN_ENV, None)
+            else:
+                os.environ[PLAN_ENV] = self._prev_env
+
+
+# Spawned producer processes (and any process launched with the env set)
+# arm themselves at import: ddl_tpu.datapusher imports this module, so
+# PROCESS-mode workers pick the plan up before their first window.
+_env_plan = os.environ.get(PLAN_ENV)
+if _env_plan:
+    try:
+        _ARMED = FaultPlan.from_json(_env_plan)
+    except (ValueError, KeyError):
+        import logging
+
+        logging.getLogger("ddl_tpu").warning(
+            "ignoring malformed %s (%d chars)", PLAN_ENV, len(_env_plan)
+        )
+del _env_plan

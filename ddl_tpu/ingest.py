@@ -334,6 +334,17 @@ def north_star_report(
     report["pool_hits"] = m.counter("staging.pool_hits")
     report["pool_misses"] = m.counter("staging.pool_misses")
     report["queue_depth_max"] = m.gauge("staging.queue_depth.max")
+    # Robustness observability (ISSUE 3): recovery events must be visible
+    # in the report and the bench JSON trajectories, not just in logs —
+    # a "passing" run that silently replayed half its windows is a
+    # regression the BENCH_* history should show.
+    report["respawns"] = m.counter("watchdog.respawns")
+    report["watchdog_failures"] = m.counter("watchdog.failures")
+    report["corrupt_windows"] = m.counter("integrity.corrupt_windows")
+    report["replays"] = m.counter("integrity.replays")
+    report["shuffle_degraded"] = m.counter("shuffle.degraded")
+    report["staging_retries"] = m.counter("staging.retries")
+    report["inline_fallbacks"] = m.counter("staging.inline_fallbacks")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (
@@ -406,12 +417,16 @@ class PrefetchIterator:
                 host_batch = next(self._it)
             except StopIteration:
                 break
-            if engine is not None and engine.direct_left == 0:
+            if (
+                engine is not None
+                and not engine.faulted
+                and engine.direct_left == 0
+            ):
                 self._queue.append(
                     engine.submit(host_batch, self._transfer)
                 )
             else:
-                if engine is not None:
+                if engine is not None and not engine.faulted:
                     engine.direct_left -= 1
                 self._queue.append(self._put(host_batch))
         if not self._queue:
@@ -420,8 +435,11 @@ class PrefetchIterator:
         if isinstance(head, StagedTransfer):
             # Work-stealing pop: an unstarted head job runs inline here
             # (never slower than the inline path); a worker-claimed one
-            # is a genuine wait, counted as ingest.stall.
-            value = engine.executor.complete(head)
+            # is a genuine wait, counted as ingest.stall.  On transfer-
+            # retry exhaustion the engine salvages the verified staging
+            # copy down the inline path (degradation ladder; no loss,
+            # no dup — `engine.faulted` routes later batches inline).
+            value = engine.complete_or_salvage(head, self._put)
             if head.worker_executed:
                 engine.stolen_streak = 0
             else:

@@ -18,6 +18,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ddl_tpu.datasetwrapper import DataProducerOnInitReturn, ProducerFunctionSkeleton
+from ddl_tpu.exceptions import IntegrityError
 
 
 def _my_shard(n_items: int, producer_idx: int, n_producers: int,
@@ -350,26 +351,149 @@ class WebDatasetProducer(ProducerFunctionSkeleton):
 
 # -- TFRecord / tf.Example (stdlib-only micro parsers) ------------------------
 
+# CRC32C (Castagnoli) — the TFRecord framing checksum — implemented with
+# numpy lookup tables, no tensorflow/crc32c dependency.  Verified against
+# the spec's check vector (crc32c(b"123456789") == 0xE3069283,
+# tests/test_faults.py).  Structure: slicing-by-K generalised to a WIDE
+# stripe (K = 2048) so each Python-loop step checksums a whole stripe
+# with one vectorised table gather + XOR reduction — a narrow
+# slicing-by-8 loop costs ~1 MiB/s in numpy scalar indexing, which would
+# throttle the producer fill path the moment validation defaults on.
 
-def iter_tfrecords(path: str):
+_CRC32C_POLY = 0x82F63B78  # reversed Castagnoli polynomial
+_CRC32C_STRIPE = 2048  # bytes per vectorised step (table: K*256*4 = 2 MiB)
+_crc32c_byte_table: Optional[np.ndarray] = None
+_crc32c_stripe_table: Optional[np.ndarray] = None
+
+
+def _make_crc32c_tables() -> tuple:
+    t0 = np.empty(256, np.uint32)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (_CRC32C_POLY if c & 1 else 0)
+        t0[i] = c
+    # chain[m][b]: CRC contribution of byte b followed by m zero bytes;
+    # stripe[j] is the table for position j within a K-byte stripe
+    # (byte j is followed by K-1-j bytes).
+    stripe = np.empty((_CRC32C_STRIPE, 256), np.uint32)
+    prev = t0
+    stripe[_CRC32C_STRIPE - 1] = t0
+    for m in range(1, _CRC32C_STRIPE):
+        prev = t0[prev & 0xFF] ^ (prev >> np.uint32(8))
+        stripe[_CRC32C_STRIPE - 1 - m] = prev
+    return t0, stripe
+
+
+def _crc32c_update_bytes(crc: int, buf: np.ndarray, t0: np.ndarray) -> int:
+    """Per-byte tail update (buf shorter than one stripe)."""
+    for b in buf:
+        crc = int(t0[(crc ^ int(b)) & 0xFF]) ^ (crc >> 8)
+    return crc
+
+
+def crc32c(data) -> int:
+    """CRC32C of a bytes-like / uint8 array.
+
+    Whole stripes of ``_CRC32C_STRIPE`` bytes are folded with one numpy
+    gather + ``bitwise_xor.reduce`` each (the running CRC is XORed into
+    the stripe's first 4 bytes, per slicing-by-N); the sub-stripe tail
+    falls back to the per-byte table loop.  Measured ~2 orders of
+    magnitude over a scalar-indexing loop — validation at ingest cadence
+    without a native dependency.
+    """
+    global _crc32c_byte_table, _crc32c_stripe_table
+    if _crc32c_byte_table is None:
+        _crc32c_byte_table, _crc32c_stripe_table = _make_crc32c_tables()
+    t0, stripe = _crc32c_byte_table, _crc32c_stripe_table
+    buf = np.frombuffer(memoryview(data), np.uint8)
+    crc = 0xFFFFFFFF
+    K = _CRC32C_STRIPE
+    nstripes = len(buf) // K
+    if nstripes:
+        # Flattened-table gather (one int add + 1-D take) measures 2x
+        # the 2-D fancy index.
+        flat = stripe.ravel()
+        offs = np.arange(K, dtype=np.int64) * 256
+        for s in range(nstripes):
+            block = buf[s * K : (s + 1) * K]
+            # Fold the running CRC into the stripe's first 4 bytes
+            # (little-endian), per slicing-by-N.
+            head = block[:4] ^ np.frombuffer(
+                crc.to_bytes(4, "little"), np.uint8
+            )
+            crc = int(
+                np.bitwise_xor.reduce(flat[offs[:4] + head])
+                ^ np.bitwise_xor.reduce(flat[offs[4:] + block[4:]])
+            )
+    crc = _crc32c_update_bytes(crc, buf[nstripes * K :], t0)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data) -> int:
+    """The TFRecord 'masked' CRC: rotate right 15 and add a constant —
+    guards against CRCs of CRCs looking valid (TFRecord spec)."""
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def tfrecord_crc_enabled(override: Optional[bool] = None) -> bool:
+    """The ``DDL_TPU_TFRECORD_CRC`` gate (default ON).  The opt-out is
+    for trusted local data where the decode throughput matters more than
+    detecting at-rest corruption."""
+    from ddl_tpu.utils import env_flag
+
+    return env_flag("DDL_TPU_TFRECORD_CRC", override)
+
+
+def iter_tfrecords(path: str, verify_crc: Optional[bool] = None):
     """Yield raw record payloads from a TFRecord file.
 
-    Framing (TFRecord spec): u64le length, u32 length-crc, payload,
-    u32 payload-crc.  CRCs are not validated (no tensorflow dependency;
-    corrupt files surface as struct errors or bad downstream parses).
+    Framing (TFRecord spec): u64le length, u32 masked length-crc,
+    payload, u32 masked payload-crc.  Both CRCs are validated (pure
+    numpy CRC32C — no tensorflow dependency) and a mismatch raises
+    :class:`~ddl_tpu.exceptions.IntegrityError` with file/offset
+    context; ``verify_crc=False`` (or ``DDL_TPU_TFRECORD_CRC=0``) skips
+    validation for trusted local data.  A TRUNCATED final record
+    (anywhere short of its full ``length + trailer`` framing) is treated
+    as end-of-stream in BOTH modes — the validation knob must never
+    change which records a file serves, only whether they are checked.
     """
     import struct
 
+    verify = tfrecord_crc_enabled(verify_crc)
     with open(path, "rb") as f:
+        offset = 0
         while True:
             head = f.read(12)
             if len(head) < 12:
                 return
             (length,) = struct.unpack("<Q", head[:8])
+            if verify:
+                (got_len_crc,) = struct.unpack("<I", head[8:12])
+                want_len_crc = masked_crc32c(head[:8])
+                if got_len_crc != want_len_crc:
+                    raise IntegrityError(
+                        f"{path}: corrupt TFRecord length-crc at offset "
+                        f"{offset} (0x{got_len_crc:08x} != "
+                        f"0x{want_len_crc:08x})"
+                    )
             payload = f.read(length)
             if len(payload) < length:
                 return
-            f.read(4)  # payload crc
+            tail = f.read(4)
+            if len(tail) < 4:
+                return  # truncated trailer: end-of-stream (both modes)
+            if verify:
+                (got_crc,) = struct.unpack("<I", tail)
+                want_crc = masked_crc32c(payload)
+                if got_crc != want_crc:
+                    raise IntegrityError(
+                        f"{path}: corrupt TFRecord payload at offset "
+                        f"{offset} ({length} bytes; crc 0x{got_crc:08x} "
+                        f"!= 0x{want_crc:08x})"
+                    )
+            offset += 12 + length + 4
             yield payload
 
 
@@ -456,11 +580,15 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
     """
 
     def __init__(self, pattern: str, seq_len: int, window_rows: int,
-                 feature_key: Optional[str] = "input_ids"):
+                 feature_key: Optional[str] = "input_ids",
+                 verify_crc: Optional[bool] = None):
         self.pattern = pattern
         self.seq_len = seq_len
         self.window_rows = window_rows
         self.feature_key = feature_key
+        #: None defers to the ``DDL_TPU_TFRECORD_CRC`` gate (default on);
+        #: False is the trusted-local-data opt-out.
+        self.verify_crc = verify_crc
 
     def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
                 n_instances=1, **kw) -> DataProducerOnInitReturn:
@@ -487,7 +615,7 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
             path = self._shards[shard_i % len(self._shards)]
             shard_i += 1
             grew = False
-            for payload in iter_tfrecords(path):
+            for payload in iter_tfrecords(path, verify_crc=self.verify_crc):
                 toks = self._tokens_from(payload)
                 if len(toks):
                     grew = True

@@ -30,6 +30,7 @@ string (Q8) — both strategies here are real, dispatched, and tested.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import threading
@@ -40,7 +41,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ddl_tpu.exceptions import DDLError, ShutdownRequested
+from ddl_tpu.faults import fault_point
+from ddl_tpu.observability import metrics as default_metrics
 from ddl_tpu.types import Topology
+
+logger = logging.getLogger("ddl_tpu")
 
 #: Permutation search bound (reference ``shuffle.py:74-79`` used 1000 and
 #: SystemExit; we raise a typed error instead).
@@ -376,6 +381,12 @@ class ThreadExchangeShuffler:
     — and, with the fixed dispatcher, it actually runs each iteration.
     """
 
+    #: Consecutive peer losses tolerated (each degrading one round to a
+    #: node-local shuffle) before the exchange is disabled for the rest
+    #: of the run — the documented degradation ladder's terminal rung
+    #: for shuffle (docs/ROBUSTNESS.md).
+    DEFAULT_MAX_PEER_LOSSES = 2
+
     def __init__(
         self,
         topology: Topology,
@@ -384,6 +395,9 @@ class ThreadExchangeShuffler:
         exchange_method: str = "sendrecv_replace",
         rendezvous: Any = None,  # Rendezvous | ShmRendezvous (put/take/discard)
         seed: int = 0,
+        exchange_timeout_s: float = 60.0,
+        degrade_on_peer_loss: bool = True,
+        max_peer_losses: Optional[int] = None,
     ):
         if exchange_method not in EXCHANGE_METHODS:
             raise NotImplementedError(
@@ -394,6 +408,20 @@ class ThreadExchangeShuffler:
         self.num_exchange = num_exchange
         self.exchange_method = exchange_method
         self.seed = seed
+        self.exchange_timeout_s = exchange_timeout_s
+        #: ``True`` (default): a lost exchange partner degrades the round
+        #: to a node-local shuffle with a loud warning + metric instead
+        #: of stalling the pipeline until timeout-death.  ``False``
+        #: restores raise-on-loss for callers that prefer to crash.
+        self.degrade_on_peer_loss = degrade_on_peer_loss
+        self.max_peer_losses = (
+            self.DEFAULT_MAX_PEER_LOSSES
+            if max_peer_losses is None
+            else max_peer_losses
+        )
+        self.metrics = default_metrics()
+        self._peer_losses = 0  # consecutive; reset by a healthy round
+        self._degraded = False  # terminal: exchange disabled for the run
         self._rdv = rendezvous or _default_rendezvous
         self._round = 0
         # Outgoing keys of the last two rounds: swept when their replay
@@ -432,11 +460,48 @@ class ThreadExchangeShuffler:
         implements its own round re-entry here."""
         self._round = int(round_)
 
+    def _local_shuffle(self, my_ary: np.ndarray) -> None:
+        """Node-local fallback: a deterministic in-place row permutation
+        seeded by (seed, producer, round) — preserves this producer's row
+        multiset exactly (no loss, no duplication) while the exchange
+        fabric is unavailable."""
+        rng = np.random.default_rng(
+            [self.seed & 0x7FFFFFFF, self.producer_idx, self._round]
+        )
+        rng.shuffle(my_ary)
+
+    def _degrade_round(self, my_ary: np.ndarray, why: Exception) -> None:
+        """Degradation ladder, shuffle rung: count the loss, shuffle
+        locally, and after ``max_peer_losses`` consecutive losses disable
+        the exchange for the rest of the run (stalling every remaining
+        round against a dead peer would serve nothing)."""
+        self._peer_losses += 1
+        self.metrics.incr("shuffle.degraded")
+        logger.error(
+            "global shuffle: exchange peer lost in round %d (%s) — "
+            "degrading to node-local shuffle (loss %d/%d)",
+            self._round, why, self._peer_losses, self.max_peer_losses,
+        )
+        if self._peer_losses >= self.max_peer_losses and not self._degraded:
+            self._degraded = True
+            logger.error(
+                "global shuffle: %d consecutive peer losses — exchange "
+                "DISABLED for the rest of the run; data mixing is now "
+                "node-local only", self._peer_losses,
+            )
+        self._local_shuffle(my_ary)
+
     def global_shuffle(self, my_ary: np.ndarray, should_abort: Any = None,
                        **kwargs: Any) -> None:
         n = self.topology.n_instances
         me = self.topology.instance_idx
         if n <= 1 or self.num_exchange < 2:
+            return
+        if self._degraded:
+            # Terminal rung reached earlier: keep mixing locally, keep
+            # the round counter advancing (checkpoints stay coherent).
+            self._local_shuffle(my_ary)
+            self._round += 1
             return
         p = exchange_permutation(n, self.seed + self.producer_idx, self._round)
         pinv = inverse_permutation(p)
@@ -479,24 +544,54 @@ class ThreadExchangeShuffler:
             if n == 2:  # the sweep only runs (and is only safe) at n == 2
                 self._sent.append((self._round, put_key))
             try:
-                my_ary[lane] = self._rdv.take(
-                    (self.producer_idx, t, me), should_abort=should_abort
+                fault_point(
+                    "shuffle.exchange", producer_idx=self.producer_idx
                 )
-            except (ShutdownRequested, DDLError):
-                # The partner never showed (shutdown or timeout): retract
-                # our half so a later run on the same rendezvous cannot
-                # pop this round's stale rows as its own round 0.  (A
-                # producer that CRASHES mid-exchange can still leave a
-                # box behind — pass a fresh Rendezvous per run where
-                # that matters rather than the module default.)
+                my_ary[lane] = self._rdv.take(
+                    (self.producer_idx, t, me),
+                    timeout_s=self.exchange_timeout_s,
+                    should_abort=should_abort,
+                )
+            except ShutdownRequested:
+                # Clean teardown: retract our half so a later run on the
+                # same rendezvous cannot pop this round's stale rows as
+                # its own round 0.  (A producer that CRASHES mid-exchange
+                # can still leave a box behind — pass a fresh Rendezvous
+                # per run where that matters rather than the module
+                # default.)
                 self._rdv.discard(put_key)
                 raise
+            except DDLError as e:
+                # The partner never showed (dead peer / injected loss):
+                # retract our half, then degrade this round to a
+                # node-local shuffle instead of stalling the pipeline —
+                # unless the caller opted back into raise-on-loss.
+                self._rdv.discard(put_key)
+                if not self.degrade_on_peer_loss:
+                    raise
+                self._degrade_round(my_ary, e)
+                self._round += 1
+                return
+        self._peer_losses = 0  # a healthy round resets the ladder
         self._round += 1
 
     # Factory signature expected by DataPusher's shuffler_factory hook.
     @classmethod
-    def factory(cls, rendezvous: Any = None, seed: int = 0):
-        return ExchangeShufflerFactory(rendezvous=rendezvous, seed=seed)
+    def factory(
+        cls,
+        rendezvous: Any = None,
+        seed: int = 0,
+        exchange_timeout_s: float = 60.0,
+        degrade_on_peer_loss: bool = True,
+        max_peer_losses: Optional[int] = None,
+    ):
+        return ExchangeShufflerFactory(
+            rendezvous=rendezvous,
+            seed=seed,
+            exchange_timeout_s=exchange_timeout_s,
+            degrade_on_peer_loss=degrade_on_peer_loss,
+            max_peer_losses=max_peer_losses,
+        )
 
 
 class ExchangeShufflerFactory:
@@ -509,9 +604,19 @@ class ExchangeShufflerFactory:
     :class:`Rendezvous` is not picklable by design (its reach is one
     process)."""
 
-    def __init__(self, rendezvous: Any = None, seed: int = 0):
+    def __init__(
+        self,
+        rendezvous: Any = None,
+        seed: int = 0,
+        exchange_timeout_s: float = 60.0,
+        degrade_on_peer_loss: bool = True,
+        max_peer_losses: Optional[int] = None,
+    ):
         self.rendezvous = rendezvous
         self.seed = seed
+        self.exchange_timeout_s = exchange_timeout_s
+        self.degrade_on_peer_loss = degrade_on_peer_loss
+        self.max_peer_losses = max_peer_losses
 
     def __call__(
         self,
@@ -527,4 +632,7 @@ class ExchangeShufflerFactory:
             exchange_method,
             rendezvous=self.rendezvous,
             seed=self.seed,
+            exchange_timeout_s=self.exchange_timeout_s,
+            degrade_on_peer_loss=self.degrade_on_peer_loss,
+            max_peer_losses=self.max_peer_losses,
         )

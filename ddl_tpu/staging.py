@@ -40,6 +40,7 @@ buffer recycles.
 from __future__ import annotations
 
 import collections
+import logging
 import os
 import threading
 import time
@@ -47,8 +48,16 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ddl_tpu.exceptions import ShutdownRequested, StallTimeoutError
+from ddl_tpu import integrity
+from ddl_tpu.exceptions import (
+    IntegrityError,
+    ShutdownRequested,
+    StallTimeoutError,
+)
+from ddl_tpu.faults import fault_point
 from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+logger = logging.getLogger("ddl_tpu")
 
 #: Per-(shape, dtype) cap on retained free buffers.  Beyond it a released
 #: buffer is dropped to the allocator — a pool must bound worst-case host
@@ -59,12 +68,32 @@ DEFAULT_POOL_CAP = 8
 #: host-memory growth when the producer side outruns the device link.
 DEFAULT_QUEUE_DEPTH = 4
 
+#: Bounded retries per staged job phase (copy / transfer) before the
+#: degradation ladder falls back to the sanctioned inline path
+#: (``DDL_TPU_STAGING_RETRIES`` overrides; docs/ROBUSTNESS.md).
+DEFAULT_MAX_RETRIES = 2
+
+#: Exponential-backoff base/cap between retries.  The cap keeps a
+#: persistently failing link from turning each window into a minutes-long
+#: stall before the fallback engages.
+_RETRY_BACKOFF_BASE_S = 0.05
+_RETRY_BACKOFF_CAP_S = 1.0
+
+
+def _flat_u8(arr: np.ndarray) -> Optional[np.ndarray]:
+    """Flat uint8 alias of an array (for byte-level fault injection);
+    None when the layout does not allow one."""
+    try:
+        return arr.reshape(-1).view(np.uint8)
+    except (ValueError, AttributeError):
+        return None
+
 
 def staged_enabled(override: Optional[bool] = None) -> bool:
     """The ``DDL_TPU_STAGED`` gate (default ON; ``0`` = inline path)."""
-    if override is not None:
-        return override
-    return os.environ.get("DDL_TPU_STAGED", "1") != "0"
+    from ddl_tpu.utils import env_flag
+
+    return env_flag("DDL_TPU_STAGED", override)
 
 
 class StagingPool:
@@ -253,14 +282,21 @@ class StagedTransfer:
     longer references the caller's source buffer (a ring-slot view), so
     the slot may be released early.  ``ready`` fires when the device
     value can be popped with :meth:`result`.
+
+    ``salvage`` is the degradation-ladder handoff: when the transfer
+    exhausted its bounded retries, the staged host buffer (whose copy
+    DID land, and was CRC-verified when the caller asked) is retained
+    here so the consumer can re-run the window down the sanctioned
+    inline path — the failure costs latency, never data.
     """
 
-    __slots__ = ("copy_done", "ready", "error", "_value", "_job")
+    __slots__ = ("copy_done", "ready", "error", "salvage", "_value", "_job")
 
     def __init__(self) -> None:
         self.copy_done = threading.Event()
         self.ready = threading.Event()
         self.error: Optional[BaseException] = None
+        self.salvage: Optional[np.ndarray] = None
         self._value: Any = None
         self._job: Any = None  # back-ref for work stealing
 
@@ -268,7 +304,9 @@ class StagedTransfer:
         """The transferred device value; raises the job's error (e.g.
         :class:`ShutdownRequested` when the executor closed mid-queue)."""
         if not self.ready.wait(timeout_s):
-            raise TimeoutError(
+            # StallTimeoutError (which is also a TimeoutError) so every
+            # deadline failure on a framework path shares one hierarchy.
+            raise StallTimeoutError(
                 f"staged transfer not ready within {timeout_s}s"
             )
         if self.error is not None:
@@ -287,14 +325,25 @@ TransferFn = Callable[[np.ndarray], Tuple[Any, Any]]
 
 
 class _Job:
-    __slots__ = ("handle", "src", "transfer", "claimed", "worker")
+    __slots__ = (
+        "handle", "src", "transfer", "expected_crc", "claimed", "worker",
+    )
 
     def __init__(
-        self, handle: StagedTransfer, src: np.ndarray, transfer: TransferFn
+        self,
+        handle: StagedTransfer,
+        src: np.ndarray,
+        transfer: TransferFn,
+        expected_crc: Optional[int] = None,
     ):
         self.handle = handle
         self.src = src
         self.transfer = transfer
+        #: Committed payload CRC (ddl_tpu.integrity): when set, the
+        #: staging copy is re-verified against it before the source slot
+        #: may be released — the second verification point of the
+        #: end-to-end pipeline.
+        self.expected_crc = expected_crc
         self.claimed = False
         #: True when the background worker (not a stealing consumer)
         #: executed the job — the signal adaptive consumers use to judge
@@ -335,6 +384,13 @@ class TransferExecutor:
             else max_queue
         )
         self._max_queue = max(1, depth)
+        self._max_retries = int(
+            os.environ.get("DDL_TPU_STAGING_RETRIES", DEFAULT_MAX_RETRIES)
+        )
+        #: Set when a job exhausted its retry budget: the degradation
+        #: ladder's "stop staging, go inline" latch, consulted by the
+        #: lookahead consumers via ``StagedIngestEngine.faulted``.
+        self.faulted = False
         self._dq: Deque[_Job] = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
@@ -350,13 +406,20 @@ class TransferExecutor:
         #: worker that never drains.
         self.worker_min_depth = min(2, self._max_queue)
 
-    def submit(self, src: np.ndarray, transfer: TransferFn) -> StagedTransfer:
+    def submit(
+        self,
+        src: np.ndarray,
+        transfer: TransferFn,
+        expected_crc: Optional[int] = None,
+    ) -> StagedTransfer:
         """Enqueue one job: copy ``src`` into a pooled buffer, then run
         ``transfer`` on it.  ``src`` may be a live ring-slot view — the
         caller must keep the slot acquired until ``handle.copy_done``.
-        Blocks when the queue is full (backpressure)."""
+        ``expected_crc`` (the committed window CRC) re-verifies the copy
+        before that release.  Blocks when the queue is full
+        (backpressure)."""
         handle = StagedTransfer()
-        job = _Job(handle, src, transfer)
+        job = _Job(handle, src, transfer, expected_crc)
         handle._job = job
         with self._cv:
             if self._closed:
@@ -496,18 +559,78 @@ class TransferExecutor:
 
     # -- execution ---------------------------------------------------------
 
+    def _retrying(self, phase: str, fn):
+        """Run one job phase with bounded exponential-backoff retries.
+
+        The degradation ladder's first rung: transient failures (flaky
+        link, injected chaos) are retried ``_max_retries`` times with
+        doubling backoff; exhaustion marks the executor ``faulted``
+        (later windows route inline) and re-raises for the caller's
+        salvage path.  Shutdown signals are never retried.
+        """
+        delay = _RETRY_BACKOFF_BASE_S
+        for attempt in range(self._max_retries + 1):
+            try:
+                return fn()
+            except (ShutdownRequested, KeyboardInterrupt):
+                raise
+            except Exception as e:
+                if attempt >= self._max_retries or self._closed:
+                    self.faulted = True
+                    raise
+                self.metrics.incr("staging.retries")
+                logger.warning(
+                    "staged %s failed (%s: %s) — retry %d/%d after %.2fs",
+                    phase, type(e).__name__, e, attempt + 1,
+                    self._max_retries, delay,
+                )
+                time.sleep(delay)
+                delay = min(delay * 2, _RETRY_BACKOFF_CAP_S)
+
     def _execute(self, job: _Job) -> None:
         """Run one claimed job to completion (worker or stealing thread)."""
         handle = job.handle
-        try:
-            buf = self.pool.acquire(job.src.shape, job.src.dtype)
+
+        def copy_phase():
             t0 = time.perf_counter()
+            fault_point("staging.copy", view=_flat_u8(job.src))
             np.copyto(buf, job.src, casting="no")
+            if job.expected_crc is not None:
+                # Second integrity verification point: the slot is still
+                # held, so a torn/overwritten copy is caught BEFORE the
+                # early release hands the slot back to the producer (a
+                # retry re-copies from the still-valid slot).
+                flat = _flat_u8(buf)
+                got = integrity.window_crc(flat) if flat is not None else None
+                if got is not None and got != job.expected_crc:
+                    self.metrics.incr("integrity.staging_verify_failures")
+                    raise IntegrityError(
+                        f"staging copy crc32 0x{got:08x} != committed "
+                        f"0x{job.expected_crc:08x} (torn slot read)"
+                    )
             self.metrics.add_time(
                 "ingest.stage_copy", time.perf_counter() - t0
             )
+
+        def transfer_phase():
+            fault_point("staging.transfer")
+            return job.transfer(buf)
+
+        try:
+            buf = self.pool.acquire(job.src.shape, job.src.dtype)
+            self._retrying("copy", copy_phase)
             handle.copy_done.set()  # source released: slot may free
-            value, base = job.transfer(buf)
+            try:
+                value, base = self._retrying("transfer", transfer_phase)
+            except (ShutdownRequested, KeyboardInterrupt):
+                raise
+            except Exception:
+                # The copy landed (and verified): retain it so the
+                # consumer can redo this window on the inline path —
+                # degradation, not data loss.  The buffer leaves the
+                # pool's custody for good.
+                handle.salvage = buf
+                raise
             self.pool.recycle_when_ready(buf, base)
             handle._value = value
         except (ShutdownRequested, KeyboardInterrupt) as e:
@@ -581,8 +704,48 @@ class StagedIngestEngine:
         self.stolen_streak = 0
         self.direct_left = 0
 
-    def submit(self, src: np.ndarray, transfer: TransferFn) -> StagedTransfer:
-        return self.executor.submit(src, transfer)
+    @property
+    def faulted(self) -> bool:
+        """True once a staged job exhausted its retry budget: the
+        degradation ladder routes every later window down the sanctioned
+        inline path (windows()/PrefetchIterator consult this)."""
+        return self.executor.faulted
+
+    def complete_or_salvage(
+        self,
+        handle: StagedTransfer,
+        inline_put: Callable[[np.ndarray], Any],
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """:meth:`TransferExecutor.complete` with the degradation-ladder
+        fallback: a handle whose transfer exhausted its retries (but
+        whose verified staging copy survives on ``handle.salvage``) is
+        redone through ``inline_put`` — the failure costs latency, never
+        data.  Shutdown signals and deadline expiries propagate; errors
+        with nothing to salvage re-raise.  The one implementation for
+        both lookahead consumers (``windows()`` and
+        :class:`~ddl_tpu.ingest.PrefetchIterator`)."""
+        try:
+            return self.executor.complete(handle, timeout_s)
+        except (ShutdownRequested, KeyboardInterrupt, StallTimeoutError):
+            raise
+        except Exception as e:
+            if handle.salvage is None:
+                raise
+            logger.error(
+                "staged transfer failed after retries (%s: %s) — "
+                "falling back to the inline path", type(e).__name__, e,
+            )
+            self.metrics.incr("staging.inline_fallbacks")
+            return inline_put(handle.salvage)
+
+    def submit(
+        self,
+        src: np.ndarray,
+        transfer: TransferFn,
+        expected_crc: Optional[int] = None,
+    ) -> StagedTransfer:
+        return self.executor.submit(src, transfer, expected_crc)
 
     def close(self) -> None:
         self.executor.close()

@@ -516,6 +516,10 @@ class Trainer:
                     env.workers,
                     stall_budget_s=trainer.stall_budget_s,
                     respawn=trainer.watchdog_respawn,
+                    # The trainer's registry, not the process default:
+                    # respawns/failures must show in THIS run's
+                    # north_star_report robustness block.
+                    metrics=trainer.metrics,
                 ).start()
             epoch_losses: List[float] = []
             if window_stream:

@@ -32,6 +32,10 @@ from ddl_tpu.types import (
 
 _HANDSHAKE_TIMEOUT_S = 600.0
 
+#: Sentinel returned by :meth:`ControlChannel.try_recv` when nothing is
+#: pending — distinct from None, which is a legal message payload.
+NOTHING = object()
+
 
 class ControlChannel(abc.ABC):
     """One bidirectional control-plane link (consumer ↔ one producer)."""
@@ -41,6 +45,16 @@ class ControlChannel(abc.ABC):
 
     @abc.abstractmethod
     def recv(self, timeout_s: float = _HANDSHAKE_TIMEOUT_S) -> Any: ...
+
+    def try_recv(self) -> Any:
+        """Non-blocking receive: a pending message or :data:`NOTHING`.
+
+        The producer's push loop polls this once per window (the replay
+        re-request path, ``ddl_tpu.integrity``); a broken/raced channel
+        reads as "nothing pending" — channel death is detected by the
+        blocking paths and the ring shutdown flag, not here.
+        """
+        return NOTHING  # pragma: no cover - overridden by real channels
 
     def close(self) -> None:  # pragma: no cover
         pass
@@ -66,6 +80,12 @@ class ThreadChannel(ControlChannel):
             return self._rx.get(timeout=timeout_s)
         except queue_mod.Empty as e:
             raise StallTimeoutError(f"control recv exceeded {timeout_s}s") from e
+
+    def try_recv(self) -> Any:
+        try:
+            return self._rx.get_nowait()
+        except queue_mod.Empty:
+            return NOTHING
 
 
 class PipeChannel(ControlChannel):
@@ -93,6 +113,16 @@ class PipeChannel(ControlChannel):
             # Peer process died with the channel open — fail fast instead
             # of pretending the handshake may still complete.
             raise TransportError("control channel peer closed (process died)") from e
+
+    def try_recv(self) -> Any:
+        try:
+            if not self._conn.poll(0):
+                return NOTHING
+            return self._conn.recv()
+        except (EOFError, OSError):
+            # Peer gone: the blocking paths / ring flag own that failure
+            # mode; the poll stays quiet rather than double-reporting.
+            return NOTHING
 
     def close(self) -> None:
         self._conn.close()
@@ -220,6 +250,18 @@ class ConsumerConnection:
                 f"respawned producer {producer_idx} reported different "
                 f"geometry than its predecessor"
             )
+        if getattr(reply, "integrity", False) != getattr(
+            old, "integrity", False
+        ):
+            # Env drift across a respawn (DDL_TPU_INTEGRITY changed): an
+            # unstamped replacement on a verified ring would read as
+            # unrecoverable corruption on every drain — fail HERE, at the
+            # rejoin handshake, with the real cause.
+            raise TransportError(
+                f"respawned producer {producer_idx} disagrees with its "
+                "predecessor about integrity headers (DDL_TPU_INTEGRITY "
+                "changed between incarnations)"
+            )
         # Swap only once the replacement validated; the dead producer's
         # channel fd is released rather than leaked.  Under the lock so a
         # concurrent shutdown/finalize sees either the old channel (still
@@ -249,6 +291,17 @@ class ConsumerConnection:
         # self.rings[i] stays as-is: the consumer's attachment to the
         # surviving ring is untouched by the producer's death.
         return reply
+
+    def request_replay(self, target: int, seq: int) -> None:
+        """Ask producer ``target`` (0-based ring index) to rewind and
+        re-commit its window stream from logical window ``seq``
+        (quarantine-and-replay for corrupt slots — ``ddl_tpu.integrity``).
+        Under the rejoin lock so a concurrent elastic channel swap sees a
+        consistent channel list."""
+        from ddl_tpu.types import ReplayRequest
+
+        with self._lock:
+            self.channels[target].send(ReplayRequest(seq=seq))
 
     def shutdown_operation(self) -> None:
         """Wake every producer with the shutdown flag.

@@ -35,6 +35,7 @@ from typing import Dict
 import numpy as np
 
 from ddl_tpu.exceptions import ShutdownRequested, StallTimeoutError
+from ddl_tpu.faults import fault_point
 
 #: Default wait deadline. The reference had none — a lost peer hung forever
 #: (SURVEY §5.3); 5 minutes is generous for any real refill.
@@ -173,6 +174,7 @@ class ThreadRing(WindowRing):
             )
 
     def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        fault_point("ring.fill", should_abort=self.is_shutdown)
         self._wait(
             lambda: self._committed - self._released < self.nslots,
             timeout_s,
@@ -188,6 +190,7 @@ class ThreadRing(WindowRing):
             self._cond.notify_all()
 
     def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        fault_point("ring.drain", should_abort=self.is_shutdown)
         self._wait(
             lambda: self._committed > self._released, timeout_s, "_cons_stall"
         )

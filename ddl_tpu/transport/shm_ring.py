@@ -26,6 +26,7 @@ from ddl_tpu.exceptions import (
     StallTimeoutError,
     TransportError,
 )
+from ddl_tpu.faults import fault_point
 from ddl_tpu.transport.ring import DEFAULT_TIMEOUT_S, WindowRing
 
 _CSRC = Path(__file__).parent / "csrc" / "shm_ring.cpp"
@@ -228,6 +229,7 @@ class NativeShmRing(WindowRing):
         return rc
 
     def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        fault_point("ring.fill", should_abort=self.is_shutdown)
         rc = self._lib.ddlr_acquire_fill(self._h, int(timeout_s * 1e6))
         return self._check_wait(rc, timeout_s)
 
@@ -235,6 +237,7 @@ class NativeShmRing(WindowRing):
         self._lib.ddlr_commit(self._h, slot, payload_bytes)
 
     def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        fault_point("ring.drain", should_abort=self.is_shutdown)
         rc = self._lib.ddlr_acquire_drain(self._h, int(timeout_s * 1e6))
         return self._check_wait(rc, timeout_s)
 
@@ -423,6 +426,8 @@ class PyShmRing(WindowRing):
             self._stall[key] += time.perf_counter() - t0
 
     def acquire_fill(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        fault_point("ring.fill", should_abort=self.is_shutdown)
+
         def ready():
             c, r = int(self._u64[0]), int(self._u64[1])
             return c % self.nslots if c - r < self.nslots else None
@@ -434,6 +439,8 @@ class PyShmRing(WindowRing):
         self._u64[0] = self._u64[0] + np.uint64(1)
 
     def acquire_drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> int:
+        fault_point("ring.drain", should_abort=self.is_shutdown)
+
         def ready():
             c, r = int(self._u64[0]), int(self._u64[1])
             return r % self.nslots if c > r else None

@@ -90,6 +90,25 @@ class MetaData_Producer_To_Consumer:
     batches_per_window: int
     dtype: str = "float32"  # reference hardwired float32 (SURVEY Q5); we don't
     ring_ref: Any = None  # shm name (PROCESS) or WindowRing object (THREAD)
+    #: This producer stamps checksummed window headers (ddl_tpu.integrity)
+    #: past each slot payload; the consumer verifies at drain.  Carried in
+    #: the handshake so producer and consumer always agree on slot layout.
+    integrity: bool = False
+
+
+@dataclasses.dataclass
+class ReplayRequest:
+    """Consumer → producer: re-commit the window stream from ``seq``.
+
+    Sent over the control channel when drain-time integrity verification
+    quarantines a corrupt slot (``ddl_tpu.integrity``).  The producer
+    rewinds with the same deterministic-replay recipe elastic respawn
+    uses (``on_init`` → ``post_init`` → ``fast_forward(seq)``) and
+    re-commits windows ``seq, seq+1, ...``; the consumer discards
+    in-flight successors until the replayed ``seq`` arrives.
+    """
+
+    seq: int
 
 
 @dataclasses.dataclass(frozen=True)

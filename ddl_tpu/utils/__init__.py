@@ -13,12 +13,26 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import time
-from typing import Any, Callable, Iterable, Sequence
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 from ddl_tpu.protocols import CALLBACK_POSITIONS
 
 logger = logging.getLogger("ddl_tpu")
+
+
+def env_flag(
+    name: str, override: Optional[bool] = None, default: str = "1"
+) -> bool:
+    """The repo's one boolean env-gate parser (``DDL_TPU_INTEGRITY``,
+    ``DDL_TPU_STAGED``, ``DDL_TPU_TFRECORD_CRC``, ...): an explicit
+    ``override`` wins; otherwise the variable is truthy unless set to
+    ``0``/``off``/``false`` (case-insensitive).  One shared falsy set —
+    per-module copies drifted."""
+    if override is not None:
+        return override
+    return os.environ.get(name, default).lower() not in ("0", "off", "false")
 
 
 def execute_callbacks(

@@ -21,6 +21,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ddl_tpu.exceptions import ShutdownRequested, TransportError
+from ddl_tpu.faults import fault_point
+from ddl_tpu.observability import Metrics, metrics as default_metrics
 
 logger = logging.getLogger("ddl_tpu")
 
@@ -37,13 +39,19 @@ class Watchdog:
         respawn: bool = False,
         max_respawns: int = 3,
         replay_budget_per_window_s: float = 1.0,
+        metrics: Optional[Metrics] = None,
     ):
         """``respawn=True`` turns detection into recovery: a dead
         producer worker is replaced in place (``WorkerSet.respawn`` —
         rejoin the surviving ring, fast-forward to the recorded data
         position) up to ``max_respawns`` times before falling back to
         ``on_failure``.  The reference had neither detection nor
-        recovery (SURVEY §5.3)."""
+        recovery (SURVEY §5.3).
+
+        Recovery events record into ``metrics`` (``watchdog.respawns``,
+        ``watchdog.failures``) so robustness regressions are visible in
+        ``north_star_report`` and the bench JSON trajectories, not just
+        in logs."""
         self.workers = workers
         self.poll_interval_s = poll_interval_s
         self.stall_budget_s = stall_budget_s
@@ -51,6 +59,7 @@ class Watchdog:
         self.respawn = respawn
         self.max_respawns = max_respawns
         self.replay_budget_per_window_s = replay_budget_per_window_s
+        self.metrics = metrics or default_metrics()
         self.respawns: List[int] = []  # producer_idx per respawn event
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -97,6 +106,9 @@ class Watchdog:
 
     def check_once(self) -> Optional[str]:
         """One sweep; returns a failure description or None."""
+        # Chaos hook: a spurious ShutdownRequested / crash here exercises
+        # the monitor loop's own teardown-vs-crash discrimination.
+        fault_point("watchdog.sweep")
         rings = self.workers.connection.rings
         # Clean shutdown is initiated ring-by-ring (loader.shutdown() flags
         # rings sequentially), so a sweep landing mid-teardown may see some
@@ -193,6 +205,7 @@ class Watchdog:
                     try:
                         self.workers.respawn(idx)
                         self.respawns.append(idx)
+                        self.metrics.incr("watchdog.respawns")
                         # Stall clock restarts at the respawn; the
                         # widened replay budget holds until the
                         # committed count moves past its current value.
@@ -213,5 +226,6 @@ class Watchdog:
                             "watchdog: respawn of producer %d failed", idx
                         )
                 self.failures.append(reason)
+                self.metrics.incr("watchdog.failures")
                 self.on_failure(reason)
                 return

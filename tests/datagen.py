@@ -58,11 +58,22 @@ def encode_example_int64(key, values):
     return ld(1, features)
 
 
-def write_tfrecord(path, payloads):
-    """TFRecord framing (crc fields zeroed — readers don't validate)."""
+def write_tfrecord(path, payloads, valid_crc=True):
+    """TFRecord framing with real masked CRC32C fields (the reader
+    validates by default); ``valid_crc=False`` writes zeroed CRCs for
+    corruption-path tests."""
+    from ddl_tpu.readers import masked_crc32c
+
     with open(path, "wb") as f:
         for p in payloads:
-            f.write(struct.pack("<Q", len(p)))
-            f.write(b"\x00" * 4)  # length crc
+            head = struct.pack("<Q", len(p))
+            f.write(head)
+            f.write(
+                struct.pack("<I", masked_crc32c(head)) if valid_crc
+                else b"\x00" * 4
+            )
             f.write(p)
-            f.write(b"\x00" * 4)  # payload crc
+            f.write(
+                struct.pack("<I", masked_crc32c(p)) if valid_crc
+                else b"\x00" * 4
+            )

@@ -98,7 +98,7 @@ class TestWatchdog:
         w = _FakeWorkers([_FakeRing()])
         t = threading.Thread(target=lambda: None)
         t.start()
-        t.join()
+        t.join(5.0)
         w.threads = [t]
         wd = Watchdog(w, poll_interval_s=0.01)
         assert "died" in wd.check_once()
@@ -121,7 +121,7 @@ class TestWatchdog:
         w = _FakeWorkers([r1, r2])
         t = threading.Thread(target=lambda: None)
         t.start()
-        t.join()
+        t.join(5.0)
         w.threads = [t]
         wd = Watchdog(w, poll_interval_s=0.01)
         assert wd.check_once() is None

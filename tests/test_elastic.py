@@ -264,7 +264,7 @@ def test_elastic_respawn_with_shm_rendezvous_shuffle(tmp_path):
         loader0.shutdown()
         loader1.shutdown()
         ws0.abort(), ws1.abort()
-        ws0.join(), ws1.join()
+        ws0.join(30.0), ws1.join(30.0)
     assert crossed
     assert os.path.exists(sentinel)  # the crash really fired
     assert list(wd.respawns) == [1], list(wd.respawns)
@@ -453,6 +453,130 @@ def test_replay_budget_widens_until_new_commit():
     wd.check_once()
     wd._last_change[0] = time.monotonic() - 5.0
     assert wd.check_once() is not None  # 5s > 1x budget -> stall flagged
+
+
+class _EdgeRing:
+    """Minimal ring double for watchdog edge-timing tests."""
+
+    def __init__(self):
+        self.committed = 0.0
+        self.released = 0.0
+        self.down = False
+
+    def stats(self):
+        return {
+            "committed": self.committed, "released": self.released,
+            "producer_stall_s": 0.0, "consumer_stall_s": 0.0,
+        }
+
+    def is_shutdown(self):
+        return self.down
+
+
+class _EdgeWorkers:
+    """WorkerSet double whose respawn 'succeeds' but cannot revive the
+    worker — the respawn-exhaustion scenario."""
+
+    def __init__(self, rings, dead_threads=0):
+        class _Conn:
+            pass
+
+        self.connection = _Conn()
+        self.connection.rings = rings
+        self.threads = []
+        self.processes = []
+        self.respawn_calls = []
+        self.aborted = False
+        for _ in range(dead_threads):
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join(5.0)
+            self.threads.append(t)
+
+    def respawn(self, idx):
+        self.respawn_calls.append(idx)  # "succeeds", worker stays dead
+
+    def abort(self):
+        self.aborted = True
+
+
+class TestWatchdogEdgeTiming:
+    """Edge timing the elastic suite misses (ISSUE 3 satellite): budget
+    exhaustion, death-during-shutdown, and single-firing on a stall."""
+
+    def _settle(self, cond, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while not cond() and time.monotonic() < deadline:
+            time.sleep(0.02)
+
+    def test_respawn_exhaustion_falls_through_to_on_failure(self):
+        """Every respawn 'succeeds' but the worker stays dead: after
+        max_respawns the watchdog escalates to on_failure EXACTLY once
+        (not a respawn loop, not repeated failures), and both phases
+        land in the metrics registry."""
+        from ddl_tpu.observability import Metrics
+
+        m = Metrics()
+        failures = []
+        w = _EdgeWorkers([_EdgeRing()], dead_threads=1)
+        wd = Watchdog(
+            w, poll_interval_s=0.02, respawn=True, max_respawns=2,
+            on_failure=failures.append, metrics=m,
+        ).start()
+        try:
+            self._settle(lambda: failures)
+        finally:
+            wd.stop()
+        assert len(w.respawn_calls) == 2  # budget fully used first
+        assert len(failures) == 1  # then exactly one escalation
+        assert len(wd.respawns) == 2
+        assert m.counter("watchdog.respawns") == 2
+        assert m.counter("watchdog.failures") == 1
+
+    def test_producer_death_during_shutdown_is_not_a_failure(self):
+        """A worker that exits while rings are flagged for shutdown is
+        clean teardown, not a failure: no respawn, no on_failure, zero
+        failure metrics — even across many sweeps."""
+        from ddl_tpu.observability import Metrics
+
+        m = Metrics()
+        ring = _EdgeRing()
+        ring.down = True  # teardown in progress
+        w = _EdgeWorkers([ring], dead_threads=1)
+        wd = Watchdog(
+            w, poll_interval_s=0.02, respawn=True, metrics=m,
+        ).start()
+        time.sleep(0.3)  # many sweeps over the dead-worker state
+        wd.stop()
+        assert w.respawn_calls == []
+        assert wd.failures == []
+        assert m.counter("watchdog.respawns") == 0
+        assert m.counter("watchdog.failures") == 0
+        assert not w.aborted
+
+    def test_stalled_but_alive_crosses_budget_exactly_once(self):
+        """A stalled-but-alive producer (progress frozen, thread alive —
+        nothing to respawn in THREAD mode without respawn=True) crossing
+        stall_budget_s fires on_failure exactly once; the monitor does
+        not re-fire every sweep afterwards."""
+        from ddl_tpu.observability import Metrics
+
+        m = Metrics()
+        failures = []
+        ring = _EdgeRing()  # committed == released == 0: producer owes one
+        w = _EdgeWorkers([ring])
+        wd = Watchdog(
+            w, poll_interval_s=0.02, stall_budget_s=0.15,
+            on_failure=failures.append, metrics=m,
+        ).start()
+        try:
+            self._settle(lambda: failures)
+            time.sleep(0.3)  # would re-fire here if the monitor looped
+        finally:
+            wd.stop()
+        assert len(failures) == 1, failures
+        assert "no progress" in failures[0]
+        assert m.counter("watchdog.failures") == 1
 
 
 def test_fast_forward_default_replays_execute_function():

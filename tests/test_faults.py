@@ -1,0 +1,657 @@
+"""Chaos suite: seeded fault injection against full loader pipelines.
+
+Tier-1 runs a DETERMINISTIC single-fault matrix — for every fault kind
+the pipeline must either recover with byte-identical, exactly-once
+delivery of the window stream, or degrade along the documented ladder
+(docs/ROBUSTNESS.md).  It must never deadlock, and never silently drop
+or duplicate a window.  ``@pytest.mark.slow`` adds a randomized
+multi-fault soak (``make chaos``).
+
+The producer serves a fully deterministic pattern (window ``it`` has
+every element derived from ``(producer, it, position)``), so "recovered"
+is asserted at byte granularity, not just by count.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ddl_tpu import (
+    DataProducerOnInitReturn,
+    DistributedDataLoader,
+    Marker,
+    ProducerFunctionSkeleton,
+    distributed_dataloader,
+)
+from ddl_tpu import faults, integrity
+from ddl_tpu.exceptions import (
+    IntegrityError,
+    InjectedFault,
+    ShutdownRequested,
+    TransportError,
+)
+from ddl_tpu.faults import FaultKind, FaultPlan, FaultSpec, fault_point
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+from ddl_tpu.watchdog import Watchdog
+
+N_DATA, N_VALUES = 16, 4
+SHAPE = (N_DATA, N_VALUES)
+
+
+def pattern(it: int, producer_idx: int = 1) -> np.ndarray:
+    """Byte-deterministic content of window ``it`` (1-based)."""
+    base = producer_idx * 100_000 + it * 1_000
+    return (
+        base + (np.arange(N_DATA * N_VALUES, dtype=np.float32) % 997)
+    ).reshape(SHAPE).astype(np.float32)
+
+
+class PatternProducer(ProducerFunctionSkeleton):
+    """Windows 1, 2, 3, ... of :func:`pattern` — replayable by the default
+    ``fast_forward`` (state advances only through ``execute_function``)."""
+
+    def on_init(self, producer_idx=1, **kw):
+        self.idx = producer_idx
+        self.it = 0
+        return DataProducerOnInitReturn(
+            nData=N_DATA, nValues=N_VALUES, shape=SHAPE,
+            splits=(N_VALUES - 1, 1),
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        self.it += 1
+        my_ary[:] = pattern(self.it, self.idx)
+
+
+def drain_numpy(plan, n_epochs=6, metrics=None, stall_budget_s=60.0):
+    """Run a 1-producer THREAD pipeline under ``plan``; return the window
+    arrays served, the watchdog, and the metrics registry."""
+    m = metrics or Metrics()
+
+    @distributed_dataloader(n_producers=1, mode="thread")
+    def main(env):
+        wd = Watchdog(
+            env.workers, poll_interval_s=0.1, stall_budget_s=stall_budget_s,
+            respawn=True, metrics=m,
+        ).start()
+        try:
+            loader = DistributedDataLoader(
+                PatternProducer(), batch_size=N_DATA,
+                connection=env.connection, n_epochs=n_epochs,
+                output="numpy", timeout_s=60.0, metrics=m,
+            )
+            windows = []
+            for _ in range(n_epochs):
+                for cols in loader:
+                    windows.append(np.hstack([np.asarray(c) for c in cols]))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+        finally:
+            wd.stop()
+        return windows, wd
+
+    with faults.armed(plan):
+        windows, wd = main()
+    return windows, wd, m
+
+
+def drain_windows_jax(plan, n_epochs=5, metrics=None):
+    """Run the staged ``windows()`` stream (engine forced on) under
+    ``plan``; return served window arrays, the metrics, and the loader's
+    engine-faulted flag."""
+    m = metrics or Metrics()
+
+    @distributed_dataloader(n_producers=1, mode="thread")
+    def main(env):
+        loader = DistributedDataLoader(
+            PatternProducer(), batch_size=N_DATA,
+            connection=env.connection, n_epochs=n_epochs, output="jax",
+            timeout_s=60.0, metrics=m, staged=True,
+        )
+        windows = []
+        for win in loader.windows():
+            windows.append(np.asarray(win).reshape(SHAPE).copy())
+            loader.mark(Marker.END_OF_EPOCH)
+        engine = loader._ingestor._engine
+        return windows, bool(engine is not None and engine.faulted)
+
+    with faults.armed(plan):
+        windows, faulted = main()
+    return windows, faulted, m
+
+
+def expected(n_epochs):
+    return [pattern(it) for it in range(1, n_epochs + 1)]
+
+
+def assert_byte_identical(got, n_epochs):
+    want = expected(n_epochs)
+    assert len(got) == len(want), (len(got), len(want))
+    for i, (g, w) in enumerate(zip(got, want)):
+        np.testing.assert_array_equal(g, w, err_msg=f"window {i + 1}")
+
+
+# ---------------------------------------------------------------------------
+# The deterministic single-fault matrix (tier-1).  One test per fault
+# kind; each asserts exactly-once byte-identical delivery or the
+# documented degradation — never a deadlock, drop, or duplicate.
+# ---------------------------------------------------------------------------
+
+
+class TestFaultMatrix:
+    def test_producer_crash_respawned_byte_identical(self):
+        plan = FaultPlan(
+            [FaultSpec("producer.fill", FaultKind.PRODUCER_CRASH, at=3)]
+        )
+        windows, wd, m = drain_numpy(plan)
+        assert_byte_identical(windows, 6)
+        assert list(wd.respawns) == [1]
+        assert list(wd.failures) == []
+        assert m.counter("watchdog.respawns") == 1
+        assert plan.fired and plan.fired[0][1] == "producer_crash"
+
+    def test_producer_slowdown_recovers_unassisted(self):
+        plan = FaultPlan(
+            [FaultSpec("producer.fill", FaultKind.PRODUCER_SLOWDOWN,
+                       at=2, count=2, param=0.3)]
+        )
+        windows, wd, m = drain_numpy(plan)
+        assert_byte_identical(windows, 6)
+        assert list(wd.respawns) == []
+        assert list(wd.failures) == []
+        assert len(plan.fired) == 2
+
+    def test_spurious_shutdown_respawned_byte_identical(self):
+        """A spurious ShutdownRequested kills one producer incarnation
+        cleanly; the watchdog tells a spurious signal (rings still live)
+        from real teardown and respawns into the exact position."""
+        plan = FaultPlan(
+            [FaultSpec("producer.fill", FaultKind.SPURIOUS_SHUTDOWN, at=2)]
+        )
+        windows, wd, m = drain_numpy(plan)
+        assert_byte_identical(windows, 6)
+        assert list(wd.respawns) == [1]
+        assert list(wd.failures) == []
+
+    def test_ring_corruption_quarantined_and_replayed(self):
+        """Flipped slot bytes after commit: drain-time CRC verification
+        quarantines the window and the producer replays it — the served
+        stream is byte-identical, with the corruption visible in
+        metrics, not in data."""
+        plan = FaultPlan(
+            [FaultSpec("producer.commit", FaultKind.RING_CORRUPTION,
+                       at=2, param=4)]
+        )
+        windows, wd, m = drain_numpy(plan)
+        assert_byte_identical(windows, 6)
+        assert m.counter("integrity.corrupt_windows") == 1
+        assert m.counter("integrity.replays") == 1
+        assert list(wd.failures) == []
+
+    def test_persistent_corruption_escalates_to_integrity_error(self):
+        """Corruption that survives every replay exhausts the budget and
+        raises IntegrityError — loudly, instead of serving bad bytes or
+        spinning forever (the documented terminal rung)."""
+        plan = FaultPlan(
+            [FaultSpec("producer.commit", FaultKind.RING_CORRUPTION,
+                       at=2, count=50, param=4)]
+        )
+        m = Metrics()
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                PatternProducer(), batch_size=N_DATA,
+                connection=env.connection, n_epochs=6,
+                output="numpy", timeout_s=15.0, metrics=m,
+            )
+            with pytest.raises(IntegrityError, match="still corrupt"):
+                for _ in range(6):
+                    for cols in loader:
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+            loader.shutdown()
+
+        with faults.armed(plan):
+            main()
+        assert m.counter("integrity.replays") == 2  # DDL_TPU_MAX_REPLAYS
+        assert m.counter("integrity.corrupt_windows") >= 3
+
+    def test_staging_copy_fault_retried(self):
+        """A transient staging-copy failure is retried with backoff; the
+        stream stays byte-identical and the retry is metered."""
+        plan = FaultPlan(
+            [FaultSpec("staging.copy", FaultKind.STAGING_COPY_FAIL, at=2)]
+        )
+        windows, faulted, m = drain_windows_jax(plan)
+        assert_byte_identical(windows, 5)
+        assert m.counter("staging.retries") >= 1
+        assert not faulted
+
+    def test_staged_transfer_fault_falls_back_inline(self):
+        """Persistent staged-transfer failure: bounded retries, then the
+        salvaged staging buffer rides the sanctioned inline path and the
+        engine is latched faulted — every window still arrives
+        byte-identical, exactly once."""
+        plan = FaultPlan(
+            [FaultSpec("staging.transfer", FaultKind.STAGED_TRANSFER_FAIL,
+                       at=1, count=999)]
+        )
+        windows, faulted, m = drain_windows_jax(plan)
+        assert_byte_identical(windows, 5)
+        assert m.counter("staging.retries") >= 1
+        assert m.counter("staging.inline_fallbacks") >= 1
+        assert faulted
+
+    def test_staged_transfer_timeout_recovers(self):
+        """An injected transfer delay stalls, never corrupts: the
+        bounded waits absorb it and the stream is byte-identical."""
+        plan = FaultPlan(
+            [FaultSpec("staging.transfer",
+                       FaultKind.STAGED_TRANSFER_TIMEOUT, at=2, param=0.4)]
+        )
+        windows, faulted, m = drain_windows_jax(plan)
+        assert_byte_identical(windows, 5)
+        assert m.counter("integrity.corrupt_windows") == 0
+        assert not faulted
+
+    def test_shuffle_peer_loss_degrades_to_local(self):
+        """Exchange partner lost: the round degrades to a node-local
+        shuffle (loud warning + metric) instead of stalling; after
+        max_peer_losses consecutive losses the exchange is disabled and
+        the run COMPLETES.  Row multiset per window is preserved."""
+        from ddl_tpu.env import WorkerSet
+        from ddl_tpu.shuffle import Rendezvous, ThreadExchangeShuffler
+        from ddl_tpu.types import RunMode, Topology
+
+        class TaggedShuffleProducer(ProducerFunctionSkeleton):
+            """Tagged rows, locally shuffled in place per refill — so
+            served content is a row PERMUTATION, and loss/duplication is
+            visible as a multiset change."""
+
+            def on_init(self, producer_idx=1, **kw):
+                self._rng = np.random.default_rng(0)
+                return DataProducerOnInitReturn(
+                    nData=N_DATA, nValues=N_VALUES, shape=SHAPE,
+                    splits=(N_VALUES - 1, 1),
+                )
+
+            def post_init(self, my_ary, **kw):
+                my_ary[:] = (
+                    np.arange(N_DATA, dtype=np.float32)[:, None]
+                    + np.arange(N_VALUES, dtype=np.float32)[None, :] * 100
+                )
+
+            def execute_function(self, my_ary, **kw):
+                self._rng.shuffle(my_ary)
+
+        plan = FaultPlan(
+            [FaultSpec("shuffle.exchange", FaultKind.SHUFFLE_PEER_LOSS,
+                       at=1, count=999)]
+        )
+        n_epochs = 5
+        before = default_metrics().counter("shuffle.degraded")
+        # Instance 0 of a declared 2-instance topology, with NO instance
+        # 1 running: every exchange round has a lost peer by construction.
+        topo = Topology(
+            n_instances=2, instance_idx=0, n_producers=1,
+            mode=RunMode.THREAD,
+        )
+        ws = WorkerSet(
+            topo, nslots=2,
+            shuffler_factory=ThreadExchangeShuffler.factory(
+                rendezvous=Rendezvous(),  # private: no cross-test leaks
+                exchange_timeout_s=5.0, max_peer_losses=2,
+            ),
+        )
+        t0 = time.monotonic()
+        with faults.armed(plan):
+            loader = DistributedDataLoader(
+                TaggedShuffleProducer(), batch_size=N_DATA,
+                connection=ws.connection, n_epochs=n_epochs,
+                output="numpy", global_shuffle_fraction_exchange=0.5,
+                timeout_s=60.0,
+            )
+            windows = []
+            try:
+                for _ in range(n_epochs):
+                    for cols in loader:
+                        windows.append(
+                            np.hstack([np.asarray(c) for c in cols])
+                        )
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+            finally:
+                loader.shutdown()
+                ws.abort()
+                ws.join(30.0)
+        assert len(windows) == n_epochs
+        # Degraded rounds permute rows locally: the original row tags are
+        # conserved as a multiset in EVERY served window — peer loss cost
+        # global mixing, never data.
+        tags = (
+            np.arange(N_DATA, dtype=np.float32)[:, None]
+            + np.arange(N_VALUES, dtype=np.float32)[None, :] * 100
+        )
+        for i, win in enumerate(windows):
+            np.testing.assert_array_equal(
+                np.sort(win, axis=0), np.sort(tags, axis=0),
+                err_msg=f"window {i + 1} lost/duplicated rows",
+            )
+        assert default_metrics().counter("shuffle.degraded") - before >= 2
+        # Never stalled out a full exchange timeout, let alone one per
+        # round: the injection fails fast and the latch disables the rest.
+        assert time.monotonic() - t0 < 30.0
+
+    def test_handshake_crash_fails_fast_with_typed_error(self):
+        """A producer crashing during its handshake ships the failure to
+        the consumer — construction raises TransportError promptly
+        instead of stalling until the handshake timeout."""
+        plan = FaultPlan(
+            [FaultSpec("producer.handshake", FaultKind.PRODUCER_CRASH)]
+        )
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            with pytest.raises(TransportError, match="handshake"):
+                DistributedDataLoader(
+                    PatternProducer(), batch_size=N_DATA,
+                    connection=env.connection, n_epochs=1, output="numpy",
+                )
+
+        t0 = time.monotonic()
+        with faults.armed(plan):
+            main()
+        assert time.monotonic() - t0 < 30.0
+
+
+# ---------------------------------------------------------------------------
+# Engine mechanics: determinism, matching, serialization, zero-cost.
+# ---------------------------------------------------------------------------
+
+
+class TestFaultEngine:
+    def test_disarmed_fault_point_is_a_noop(self):
+        assert faults.armed_plan() is None
+        fault_point("producer.fill", producer_idx=1)
+        fault_point("nonexistent.site", view=np.zeros(4, np.uint8))
+
+    def test_plan_json_roundtrip(self):
+        plan = FaultPlan(
+            [FaultSpec("producer.fill", FaultKind.PRODUCER_CRASH, at=3,
+                       count=2, producer_idx=1, param=0.5)],
+            seed=7,
+        )
+        back = FaultPlan.from_json(plan.to_json())
+        assert back.seed == 7
+        assert back.specs == plan.specs
+
+    def test_at_and_count_hit_windows(self):
+        plan = FaultPlan(
+            [FaultSpec("s", FaultKind.PRODUCER_CRASH, at=2, count=2)]
+        )
+        with faults.armed(plan):
+            fault_point("s")  # hit 1: below `at`
+            with pytest.raises(InjectedFault):
+                fault_point("s")  # hit 2
+            with pytest.raises(InjectedFault):
+                fault_point("s")  # hit 3
+            fault_point("s")  # hit 4: past the window
+        assert [f[3] for f in plan.fired] == [2, 3]
+
+    def test_producer_idx_narrowing(self):
+        plan = FaultPlan(
+            [FaultSpec("s", FaultKind.PRODUCER_CRASH, producer_idx=2)]
+        )
+        with faults.armed(plan):
+            fault_point("s", producer_idx=1)  # other producer: no match
+            fault_point("s")  # no producer context: no match
+            with pytest.raises(InjectedFault):
+                fault_point("s", producer_idx=2)
+
+    def test_armed_context_restores_previous_plan_and_env(self):
+        outer = FaultPlan([FaultSpec("a", FaultKind.PRODUCER_CRASH)])
+        inner = FaultPlan([FaultSpec("b", FaultKind.PRODUCER_CRASH)])
+        with faults.armed(outer):
+            with faults.armed(inner, export=True):
+                assert faults.armed_plan() is inner
+                assert faults.PLAN_ENV in os.environ
+            assert faults.armed_plan() is outer
+            assert faults.PLAN_ENV not in os.environ
+        assert faults.armed_plan() is None
+
+    def test_corruption_is_seed_deterministic(self):
+        def corrupted(seed):
+            buf = np.zeros(64, np.uint8)
+            plan = FaultPlan(
+                [FaultSpec("s", FaultKind.RING_CORRUPTION, param=4)],
+                seed=seed,
+            )
+            with faults.armed(plan):
+                fault_point("s", view=buf)
+            return buf
+
+        np.testing.assert_array_equal(corrupted(3), corrupted(3))
+        assert not np.array_equal(corrupted(3), corrupted(4))
+
+    def test_hang_observes_abort(self):
+        plan = FaultPlan(
+            [FaultSpec("s", FaultKind.PRODUCER_HANG, param=30.0)]
+        )
+        t0 = time.monotonic()
+        flag = {"down": False}
+
+        import threading
+
+        def aborter():
+            time.sleep(0.2)
+            flag["down"] = True
+
+        threading.Thread(target=aborter, daemon=True).start()
+        with faults.armed(plan):
+            with pytest.raises(ShutdownRequested):
+                fault_point("s", should_abort=lambda: flag["down"])
+        assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Integrity layer units: header codec, drain verification, TFRecord CRCs.
+# ---------------------------------------------------------------------------
+
+
+class TestIntegrityLayer:
+    def _slot(self, payload_val=7, payload_bytes=128):
+        slot = np.zeros(payload_bytes + integrity.HEADER_BYTES, np.uint8)
+        slot[:payload_bytes] = payload_val
+        integrity.write_header(
+            slot, payload_bytes, seq=5, producer_idx=2,
+            crc=integrity.window_crc(slot[:payload_bytes]),
+        )
+        return slot, payload_bytes
+
+    def test_header_roundtrip_and_verify_ok(self):
+        slot, n = self._slot()
+        hdr = integrity.read_header(slot, n)
+        assert hdr.valid_magic and hdr.seq == 5 and hdr.producer_idx == 2
+        assert integrity.verify_window(slot, n, 5, 2) is None
+
+    def test_verify_catches_flipped_byte(self):
+        slot, n = self._slot()
+        slot[17] ^= 0xFF
+        err = integrity.verify_window(slot, n, 5, 2)
+        assert err is not None and "crc32" in err
+
+    def test_verify_catches_seq_and_producer_mismatch(self):
+        slot, n = self._slot()
+        assert "seq" in integrity.verify_window(slot, n, 6, 2)
+        assert "producer" in integrity.verify_window(slot, n, 5, 3)
+
+    def test_verify_catches_unstamped_header(self):
+        slot = np.zeros(128 + integrity.HEADER_BYTES, np.uint8)
+        assert "magic" in integrity.verify_window(slot, 128, 0, 1)
+
+    def test_enable_gate(self, monkeypatch):
+        monkeypatch.delenv("DDL_TPU_INTEGRITY", raising=False)
+        assert integrity.integrity_enabled()
+        monkeypatch.setenv("DDL_TPU_INTEGRITY", "0")
+        assert not integrity.integrity_enabled()
+        assert integrity.integrity_enabled(override=True)
+
+    def test_pipeline_with_integrity_disabled(self, monkeypatch):
+        """The DDL_TPU_INTEGRITY=0 escape hatch serves the PR 2 byte
+        path: no headers, no verification, identical data."""
+        monkeypatch.setenv("DDL_TPU_INTEGRITY", "0")
+        windows, wd, m = drain_numpy(None, n_epochs=3)
+        assert_byte_identical(windows, 3)
+        assert m.counter("integrity.corrupt_windows") == 0
+
+
+class TestTFRecordCRC:
+    def _write(self, tmp_path, valid=True):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from datagen import encode_example_int64, write_tfrecord
+
+        payloads = [
+            encode_example_int64("input_ids", list(range(10 * i, 10 * i + 8)))
+            for i in range(4)
+        ]
+        path = str(tmp_path / "rec.tfrecord")
+        write_tfrecord(path, payloads, valid_crc=valid)
+        return path, payloads
+
+    def test_crc32c_check_vector(self):
+        from ddl_tpu.readers import crc32c
+
+        # The spec's check vector, plus length edge cases around the
+        # 8-byte slicing boundary.
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"") == 0
+        import zlib
+
+        data = bytes(range(256)) * 3 + b"tail"
+        # Cross-check slicing-by-8 against a per-byte reference.
+        ref = 0xFFFFFFFF
+        from ddl_tpu.readers import _make_crc32c_tables
+
+        t0 = _make_crc32c_tables()[0]
+        for b in data:
+            ref = int(t0[(ref ^ b) & 0xFF]) ^ (ref >> 8)
+        assert crc32c(data) == ref ^ 0xFFFFFFFF
+        assert crc32c(data) != (zlib.crc32(data) & 0xFFFFFFFF)  # crc32c != crc32
+
+    def test_valid_file_reads_with_verification(self, tmp_path):
+        from ddl_tpu.readers import iter_tfrecords
+
+        path, payloads = self._write(tmp_path)
+        assert list(iter_tfrecords(path, verify_crc=True)) == payloads
+
+    def test_corrupt_payload_raises_with_context(self, tmp_path):
+        from ddl_tpu.readers import iter_tfrecords
+
+        path, _ = self._write(tmp_path)
+        data = bytearray(open(path, "rb").read())
+        data[20] ^= 0xFF  # inside record 0's payload
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(IntegrityError, match="offset 0"):
+            list(iter_tfrecords(path, verify_crc=True))
+
+    def test_corrupt_length_crc_raises(self, tmp_path):
+        from ddl_tpu.readers import iter_tfrecords
+
+        path, _ = self._write(tmp_path, valid=False)  # zeroed CRCs
+        with pytest.raises(IntegrityError, match="length-crc"):
+            list(iter_tfrecords(path, verify_crc=True))
+
+    def test_opt_out_knob_skips_validation(self, tmp_path, monkeypatch):
+        from ddl_tpu.readers import iter_tfrecords
+
+        path, payloads = self._write(tmp_path, valid=False)
+        assert list(iter_tfrecords(path, verify_crc=False)) == payloads
+        monkeypatch.setenv("DDL_TPU_TFRECORD_CRC", "0")
+        assert list(iter_tfrecords(path)) == payloads
+        monkeypatch.setenv("DDL_TPU_TFRECORD_CRC", "1")
+        with pytest.raises(IntegrityError):
+            list(iter_tfrecords(path))
+
+
+# ---------------------------------------------------------------------------
+# Randomized multi-fault soak (make chaos).
+# ---------------------------------------------------------------------------
+
+
+def _random_plan(seed: int) -> FaultPlan:
+    """2 seeded faults drawn from the locally-replayable matrix."""
+    rng = np.random.default_rng(seed)
+    kinds = [
+        (FaultKind.PRODUCER_CRASH, "producer.fill", 0.0),
+        (FaultKind.PRODUCER_SLOWDOWN, "producer.fill", 0.3),
+        (FaultKind.SPURIOUS_SHUTDOWN, "producer.fill", 0.0),
+        (FaultKind.RING_CORRUPTION, "producer.commit", 3.0),
+    ]
+    specs = []
+    for pick in rng.choice(len(kinds), size=2, replace=False):
+        kind, site, param = kinds[int(pick)]
+        specs.append(
+            FaultSpec(site, kind, at=int(rng.integers(2, 6)), param=param)
+        )
+    return FaultPlan(specs, seed=seed)
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_multi_fault_byte_identical(self, seed):
+        plan = _random_plan(seed)
+        windows, wd, m = drain_numpy(plan, n_epochs=8)
+        assert_byte_identical(windows, 8)
+        assert list(wd.failures) == []
+        assert plan.fired, "no scheduled fault ever fired"
+
+    def test_process_mode_crash_respawn_with_exported_plan(self, tmp_path):
+        """PROCESS mode: the plan crosses the spawn boundary via
+        DDL_TPU_FAULT_PLAN and the spawned producer injects its own
+        crash; elastic recovery still delivers the exact stream."""
+        plan = FaultPlan(
+            [FaultSpec("producer.fill", FaultKind.PRODUCER_CRASH, at=3)]
+        )
+        m = Metrics()
+
+        @distributed_dataloader(n_producers=1, mode="process")
+        def main(env):
+            wd = Watchdog(
+                env.workers, poll_interval_s=0.2, stall_budget_s=60.0,
+                respawn=True, metrics=m,
+            ).start()
+            try:
+                loader = DistributedDataLoader(
+                    PatternProducer(), batch_size=N_DATA,
+                    connection=env.connection, n_epochs=6,
+                    output="numpy", timeout_s=120.0, metrics=m,
+                )
+                windows = []
+                for _ in range(6):
+                    for cols in loader:
+                        windows.append(
+                            np.hstack([np.asarray(c) for c in cols])
+                        )
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+            finally:
+                wd.stop()
+            return windows, wd
+
+        with faults.armed(plan, export=True):
+            windows, wd = main()
+        assert_byte_identical(windows, 6)
+        # Each spawned incarnation re-arms the plan from the env with a
+        # fresh hit counter, so late incarnations may crash (and heal)
+        # again — the count is timing-dependent, the DATA never is.
+        assert len(wd.respawns) >= 1 and set(wd.respawns) == {1}
+        assert list(wd.failures) == []

@@ -184,6 +184,10 @@ class TestNorthStarReport:
             # staged-ingest extras (ddl_tpu.staging)
             "stage_copy_s", "transfer_s", "stall_s",
             "pool_hits", "pool_misses", "queue_depth_max",
+            # robustness extras (ISSUE 3: watchdog + integrity + ladder)
+            "respawns", "watchdog_failures", "corrupt_windows",
+            "replays", "shuffle_degraded", "staging_retries",
+            "inline_fallbacks",
         }
         assert r["samples_per_sec"] > 0
 

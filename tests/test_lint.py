@@ -132,6 +132,12 @@ VIOLATIONS = {
                 staged = np.array(batch, copy=True)  # fresh per-batch copy
                 return self._transfer(staged)
     """,
+    "DDL012": """
+        def drain(q, done, worker):
+            done.wait()          # parks forever if the peer dies
+            worker.join()        # ditto
+            return q.get()       # ditto (empty queue)
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -235,6 +241,16 @@ CLEAN = {
 
         def host_side_prep(batch):
             return np.array(batch, copy=True)  # not a hot-path function
+    """,
+    "DDL012": """
+        def drain(q, done, worker, cfg, xs):
+            if not done.wait(timeout=5.0):      # bounded event wait
+                raise TimeoutError("producer never signalled")
+            worker.join(5.0)                    # bounded (positional)
+            worker.join(timeout_s=2.0)          # bounded (keyword)
+            sep = ", ".join(xs)                 # str.join has an argument
+            color = cfg.get("color")            # dict.get has an argument
+            return q.get(timeout=5.0), sep, color
     """,
 }
 

@@ -213,7 +213,7 @@ class TestShmRendezvous:
         import sys
 
         child = subprocess.Popen([sys.executable, "-c", "pass"])
-        child.wait()
+        child.wait(timeout=30)
         dead_pid = child.pid
 
         def session(pid):

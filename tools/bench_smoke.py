@@ -28,6 +28,13 @@ REQUIRED_STAGING = (
     "stage_copy_s", "transfer_s", "stall_s",
     "pool_hits", "pool_misses", "queue_depth_max",
 )
+#: Robustness extras (north_star_report robustness block) — all zero on
+#: a healthy run, but the KEYS must always be present so BENCH_*
+#: trajectories can chart recovery events.
+REQUIRED_ROBUSTNESS = (
+    "respawns", "watchdog_failures", "corrupt_windows", "replays",
+    "shuffle_degraded", "staging_retries", "inline_fallbacks",
+)
 
 
 def main() -> int:
@@ -66,6 +73,15 @@ def main() -> int:
         missing += [
             f"staging.{k}" for k in REQUIRED_STAGING if k not in staging
         ]
+    robustness = result.get("robustness")
+    if not isinstance(robustness, dict):
+        missing.append("robustness")
+    else:
+        missing += [
+            f"robustness.{k}"
+            for k in REQUIRED_ROBUSTNESS
+            if k not in robustness
+        ]
     if "ingest_inline" not in result and "errors" not in result:
         missing.append("ingest_inline")
     if missing:
@@ -81,7 +97,8 @@ def main() -> int:
     inline = result.get("ingest_inline", {}).get("samples_per_sec")
     print(
         "bench-smoke: OK — staged "
-        f"{staged} vs inline {inline} samples/s; staging extras present"
+        f"{staged} vs inline {inline} samples/s; staging + robustness "
+        "extras present"
     )
     return 0
 

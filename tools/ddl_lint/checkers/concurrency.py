@@ -192,6 +192,69 @@ class LockOrder(Checker):
     visit_Lambda = visit_FunctionDef
 
 
+#: Keyword names that bound a blocking call (any present value counts —
+#: a static check cannot prove the value is finite, only that the author
+#: thought about a deadline at all).
+_TIMEOUT_KWARGS = {"timeout", "timeout_s", "deadline", "deadline_s"}
+
+
+@register
+class UnboundedBlockingWait(Checker):
+    """DDL012: blocking waits on framework paths must carry a timeout.
+
+    ``event.wait()``, ``cond.wait()``, ``thread.join()``, ``proc.wait()``
+    and ``queue.get()`` with no timeout park the caller until the peer
+    acts — the exact primitive that turned a dead producer into a
+    cluster-wide hang in the reference (SURVEY §5.3).  On a non-daemon
+    framework path every such wait must be bounded (the waiter decides
+    what to do at the deadline: retry, escalate to the watchdog, raise
+    ``StallTimeoutError``).
+
+    Flagged, attribute calls only:
+
+    - ``x.wait()`` / ``x.join()`` with no arguments (a timeout passed
+      positionally — ``t.join(5)`` — passes; so does ``",".join(xs)``,
+      which always has an argument);
+    - ``x.get()`` with no positional arguments and no ``timeout=``
+      (``d.get(key)`` has a positional argument and passes; a zero-arg
+      ``.get()`` is only ever a queue).
+
+    Sanctioned unbounded waits (a daemon-thread join at interpreter
+    exit, a test helper joining a thread it just completed) take the
+    pragma escape: ``# ddl-lint: disable=DDL012`` with a rationale.
+    """
+
+    code = "DDL012"
+    summary = "unbounded blocking wait (no timeout) on a framework path"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+            has_timeout = any(
+                kw.arg in _TIMEOUT_KWARGS for kw in node.keywords
+            )
+            if name in ("wait", "join"):
+                if not node.args and not has_timeout:
+                    self.report(
+                        node,
+                        f".{name}() with no timeout blocks forever if the "
+                        "peer never acts; pass a deadline (and handle "
+                        "expiry) or pragma a sanctioned case",
+                    )
+            elif name == "get":
+                only_block_kw = all(
+                    kw.arg == "block" for kw in node.keywords
+                )
+                if not node.args and not has_timeout and only_block_kw:
+                    self.report(
+                        node,
+                        ".get() with no timeout blocks forever on an "
+                        "empty queue; use .get(timeout=...) and handle "
+                        "Empty",
+                    )
+        self.generic_visit(node)
+
+
 _BROAD = {"Exception", "BaseException"}
 _SIGNALS = {"ShutdownRequested", "KeyboardInterrupt", "BaseException"}
 

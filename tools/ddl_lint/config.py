@@ -36,6 +36,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL009",  # non-exhaustive enum dispatch without a default
     "DDL010",  # jax.jit constructed inside a loop
     "DDL011",  # fresh staging copy/allocation in an ingest hot path
+    "DDL012",  # unbounded blocking wait (no timeout) on a framework path
 )
 
 
