@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke check chaos
+.PHONY: lint test test-fast bench-smoke cache-bench check chaos
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -23,10 +23,18 @@ test-fast:
 bench-smoke:
 	$(PY) tools/bench_smoke.py
 
-# The one-shot local gate: static analysis + bench JSON contract.
+# Shard-cache cold/warm A/B over the throttled backend, full geometry
+# (docs/CACHING.md; headline = warm/cold speedup).
+cache-bench:
+	DDL_BENCH_MODE=cache JAX_PLATFORMS=cpu $(PY) bench.py
+
+# The one-shot local gate: static analysis + bench JSON contract (the
+# bench-smoke contract includes the cache block's byte-identity and
+# >=2x warm-vs-cold assertions).
 check: lint bench-smoke
 
 # Chaos suite: deterministic fault matrix + randomized multi-fault soak
-# (includes slow PROCESS-mode spawns; docs/ROBUSTNESS.md).
+# (includes slow PROCESS-mode spawns; docs/ROBUSTNESS.md) + the cache
+# corruption/backend-failure ladder (tests/test_cache.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py -q

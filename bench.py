@@ -933,6 +933,96 @@ def _attn_sweep(seqs=(2048, 4096, 8192)):
     return rows
 
 
+# -- shard-cache cold/warm A/B ------------------------------------------------
+
+
+def _run_cache_ab() -> dict:
+    """Cold-vs-warm epoch A/B for the shard cache over a throttled backend.
+
+    Drives a ``FileShardProducer`` refill loop directly (no loader/ring —
+    this measures the *storage* path, which is what the cache changes)
+    over a ``ThrottledBackend`` simulating a slow source, for two epochs:
+    epoch 1 pays fetch+decode per shard (and fills the cache), epoch 2
+    serves decoded shards from the warm tier.  The same two-epoch
+    sequence also runs with the cache disabled, and every epoch's served
+    bytes are CRC'd: ``byte_identical`` asserts the cached stream equals
+    the uncached one — the cache must never change data, only speed.
+
+    Geometry knobs: ``DDL_BENCH_CACHE_SHARDS`` (default 8),
+    ``DDL_BENCH_CACHE_ROWS`` (rows/shard, default 256),
+    ``DDL_BENCH_CACHE_LATENCY_S`` (per-open simulated round-trip,
+    default 0.02).
+    """
+    import shutil
+    import tempfile
+    import zlib
+
+    from ddl_tpu.cache import CacheStore, ThrottledBackend
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.readers import FileShardProducer
+
+    n_shards = int(os.environ.get("DDL_BENCH_CACHE_SHARDS", "8"))
+    rows = int(os.environ.get("DDL_BENCH_CACHE_ROWS", "256"))
+    latency = float(os.environ.get("DDL_BENCH_CACHE_LATENCY_S", "0.02"))
+    n_cols = 64
+    tmp = tempfile.mkdtemp(prefix="ddl_cache_bench_")
+    try:
+        rng = np.random.default_rng(0)
+        for i in range(n_shards):
+            np.save(
+                os.path.join(tmp, f"shard_{i:03d}.npy"),
+                rng.standard_normal((rows, n_cols)).astype(np.float32),
+            )
+        pattern = os.path.join(tmp, "shard_*.npy")
+
+        def run_epochs(cache):
+            # warm=False: the A/B measures the refill path itself; a
+            # background warmer racing epoch 1 would blur cold cost.
+            # cache=False (not None) in the control arm: None defers to
+            # the DDL_TPU_CACHE env gate, which would silently cache the
+            # "uncached" baseline on a gate-exported host.
+            prod = FileShardProducer(
+                pattern, seed=0, cache=cache if cache is not None else False,
+                backend=ThrottledBackend(latency_s=latency), warm=False,
+            )
+            ret = prod.on_init(producer_idx=1)
+            ary = np.zeros(ret.shape, ret.dtype)
+            out = []
+            for _ in range(2):  # epochs
+                crc = 0
+                t0 = time.perf_counter()
+                for _ in range(n_shards):
+                    prod.execute_function(my_ary=ary)
+                    crc = zlib.crc32(ary.tobytes(), crc)
+                dt = time.perf_counter() - t0
+                out.append((rows * n_shards / dt, crc))
+            return out
+
+        m = Metrics()
+        store = CacheStore(ram_budget_bytes=256 << 20, metrics=m)
+        cached = run_epochs(store)
+        uncached = run_epochs(None)
+        (cold_rate, cold_crc), (warm_rate, warm_crc) = cached
+        block = {
+            "shards": n_shards,
+            "rows_per_shard": rows,
+            "backend_latency_s": latency,
+            "cold_samples_per_sec": round(cold_rate, 1),
+            "warm_samples_per_sec": round(warm_rate, 1),
+            "warm_vs_cold": round(warm_rate / cold_rate, 3),
+            "byte_identical": (
+                cold_crc == uncached[0][1] and warm_crc == uncached[1][1]
+            ),
+        }
+        stats = m.prefixed("cache.")
+        for key in ("hits", "misses", "evictions", "quarantined"):
+            block[key] = stats.get(key, 0.0)
+        block["resident_bytes_max"] = stats.get("resident_bytes.max", 0.0)
+        return block
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- driver -------------------------------------------------------------------
 
 
@@ -950,6 +1040,21 @@ def main() -> None:
         "vs_baseline": None,
         "platform": platform,
     }
+
+    if mode == "cache":
+        # `make cache-bench`: ONLY the shard-cache cold/warm A/B, with
+        # its speedup ratio as the headline (docs/CACHING.md).
+        result["metric"] = "cache_warm_vs_cold"
+        result["unit"] = "x"
+        try:
+            result["cache"] = _run_cache_ab()
+            result["value"] = result["cache"]["warm_vs_cold"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["cache"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
 
     if mode in ("ingest", "all", "stream"):
         # "stream" (chip_checklist step 5's window-size sweep): ONLY the
@@ -1059,6 +1164,13 @@ def main() -> None:
                 }
             except Exception as e:  # noqa: BLE001
                 errors["ingest_no_prefetch"] = f"{type(e).__name__}: {e}"
+            try:
+                # Shard-cache cold/warm A/B over a throttled backend
+                # (ddl_tpu/cache, docs/CACHING.md): the warm tier's win
+                # on a slow source, with byte-identity asserted.
+                result["cache"] = _run_cache_ab()
+            except Exception as e:  # noqa: BLE001
+                errors["cache"] = f"{type(e).__name__}: {e}"
         def _stream_result(stream_mode: str) -> dict:
             """One gated best-of stream measurement for ``stream_mode``
             (shared by the thread and process configs so the utilization

@@ -1,0 +1,167 @@
+"""ddl_tpu.cache — content-addressed multi-tier shard cache.
+
+The storage abstraction the reference never had: every file-based
+producer in :mod:`ddl_tpu.readers` fetches shard bytes through a
+pluggable :class:`StorageBackend` and (when enabled) keeps decoded
+shards in a :class:`CacheStore` — a byte-budgeted RAM LRU over an
+integrity-checked disk spill tier — so epoch ≥ 2 skips both the fetch
+*and* the decode.  A background :class:`CacheWarmer` prefetches the next
+shards in epoch order.  docs/CACHING.md has the full design (tiers, key
+schema, knobs, failure ladder).
+
+Environment knobs (mirrored by ``LoaderConfig`` fields of the same
+lower-case names; :func:`ddl_tpu.env.distributed_dataloader` exports a
+config's cache fields back into the environment so PROCESS-mode workers
+inherit them):
+
+=============================  ============================================
+``DDL_TPU_CACHE``              gate (default **off**; ``1`` enables)
+``DDL_TPU_CACHE_RAM_MB``       RAM-tier byte budget (default 256)
+``DDL_TPU_CACHE_SPILL_DIR``    disk-tier directory (unset = no disk tier)
+``DDL_TPU_CACHE_SPILL_MB``     disk-tier byte budget (default 1024)
+``DDL_TPU_CACHE_WARM``         background warmer gate (default on)
+``DDL_TPU_CACHE_RETRIES``      backend fetch retry budget (default 3)
+``DDL_TPU_CACHE_BACKOFF_S``    base retry backoff seconds (default 0.05)
+=============================  ============================================
+
+The default store is **per process** (PROCESS-mode producers each build
+their own from the environment; THREAD-mode workers share the
+consumer's).  Tests inject explicit ``CacheStore``/backend instances
+through the reader constructors instead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ddl_tpu.cache.backends import (  # noqa: F401  (public re-exports)
+    LocalBackend,
+    StorageBackend,
+    ThrottledBackend,
+    open_with_retry,
+)
+from ddl_tpu.cache.store import (  # noqa: F401
+    KEY_SCHEMA_VERSION,
+    CacheKey,
+    CacheStore,
+)
+from ddl_tpu.cache.warmer import CacheWarmer  # noqa: F401
+from ddl_tpu.utils import env_flag
+
+__all__ = [
+    "CacheKey",
+    "CacheStore",
+    "CacheWarmer",
+    "KEY_SCHEMA_VERSION",
+    "LocalBackend",
+    "StorageBackend",
+    "ThrottledBackend",
+    "active_store",
+    "adopt_manifest",
+    "cache_enabled",
+    "default_store",
+    "open_with_retry",
+    "reset_default_store",
+    "settings_from_env",
+    "warm_enabled",
+]
+
+
+def cache_enabled(override: Optional[bool] = None) -> bool:
+    """The ``DDL_TPU_CACHE`` gate — default **off** (opt-in: the cache
+    spends host RAM/disk, which is the operator's call)."""
+    return env_flag("DDL_TPU_CACHE", override, default="0")
+
+
+def warm_enabled(override: Optional[bool] = None) -> bool:
+    """The ``DDL_TPU_CACHE_WARM`` gate (default on; only consulted when
+    the cache itself is enabled)."""
+    return env_flag("DDL_TPU_CACHE_WARM", override)
+
+
+def settings_from_env() -> dict:
+    """The ``DDL_TPU_CACHE*`` knob set, parsed (one site; config.py's
+    fields mirror these names minus the prefix)."""
+    spill_dir = os.environ.get("DDL_TPU_CACHE_SPILL_DIR") or None
+    return {
+        "ram_budget_bytes": int(
+            os.environ.get("DDL_TPU_CACHE_RAM_MB", "256")
+        ) << 20,
+        "spill_dir": spill_dir,
+        "spill_budget_bytes": int(
+            os.environ.get("DDL_TPU_CACHE_SPILL_MB", "1024")
+        ) << 20,
+    }
+
+
+def retry_settings_from_env() -> dict:
+    return {
+        "retries": int(os.environ.get("DDL_TPU_CACHE_RETRIES", "3")),
+        "backoff_s": float(os.environ.get("DDL_TPU_CACHE_BACKOFF_S", "0.05")),
+    }
+
+
+_default_store: Optional[CacheStore] = None
+_store_lock = threading.Lock()
+
+
+def default_store() -> CacheStore:
+    """The process-default :class:`CacheStore`, built once from the
+    environment.  THREAD-mode producers (and the consumer) share it;
+    each PROCESS-mode worker builds its own on first shard read."""
+    global _default_store
+    with _store_lock:
+        if _default_store is None:
+            _default_store = CacheStore(**settings_from_env())
+        return _default_store
+
+
+def active_store() -> Optional[CacheStore]:
+    """The default store if one was already built, else ``None`` —
+    checkpoint capture must not conjure a store as a side effect."""
+    with _store_lock:
+        return _default_store
+
+
+def reset_default_store() -> None:
+    """Drop the process-default store (tests re-gate the environment)."""
+    global _default_store
+    with _store_lock:
+        _default_store = None
+
+
+def adopt_manifest(spill_dir: str, key_schema: int) -> bool:
+    """Adopt a checkpoint's cache manifest so the resumed run warm-starts
+    from a previous run's disk tier instead of refetching every shard.
+
+    Two mechanisms, because adoption can arrive before OR after the
+    store exists:
+
+    - the env var carries it forward: workers (and a default store)
+      built *after* this call pick the spill dir up — PROCESS-mode
+      producers inherit their environment at spawn, so for them the
+      manifest must be adopted **before** ``distributed_dataloader``
+      runs (:func:`ddl_tpu.checkpoint.adopt_cache_manifest` is the
+      pre-spawn helper);
+    - a default store **already built** RAM-only gets the tier attached
+      in place (:meth:`CacheStore.attach_spill_dir`) — the THREAD-mode
+      resume shape, where ``LoaderCheckpoint.apply`` runs after the
+      loader (and the shared store) exists.
+
+    Refused (returns False) when the manifest was written under a
+    different key schema, the directory is gone, or a live store
+    already points at a *different* spill dir — adoption must never
+    silently re-route a live tier.
+    """
+    if key_schema != KEY_SCHEMA_VERSION:
+        return False
+    if not spill_dir or not os.path.isdir(spill_dir):
+        return False
+    with _store_lock:
+        store = _default_store
+    if store is not None and not store.attach_spill_dir(spill_dir):
+        return False
+    os.environ["DDL_TPU_CACHE_SPILL_DIR"] = spill_dir
+    return True

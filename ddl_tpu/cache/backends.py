@@ -1,0 +1,193 @@
+"""Pluggable storage backends: every shard read goes through one of these.
+
+The reference (and our pre-cache readers) opened shard files with bare
+``open``/``np.load`` — no seam to put a remote store, a latency model, or
+a failure model behind.  :class:`StorageBackend` is that seam: a tiny
+protocol (``open`` / ``fingerprint``) the file-based producers in
+``ddl_tpu.readers`` and the cache tier call for every shard byte they
+touch.
+
+- :class:`LocalBackend` — the local filesystem (the production default).
+- :class:`ThrottledBackend` — wraps another backend with configurable
+  per-open latency and a deterministic transient-failure schedule.  It
+  exists so the bench's cold-vs-warm A/B and the chaos suite exercise a
+  realistic *slow, flaky* source without needing network access: a warm
+  cache tier only proves itself against a source that actually costs
+  something.
+
+Transient failures surface as :class:`~ddl_tpu.exceptions.BackendFetchError`;
+:func:`open_with_retry` is the one retry/backoff policy site (bounded
+attempts, exponential backoff, shutdown-observing sleeps) — exhaustion
+escalates to :class:`~ddl_tpu.exceptions.IntegrityError`, the "persistent
+backend failure" rung of the degradation ladder (docs/CACHING.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import BinaryIO, Optional, Protocol, runtime_checkable
+
+from ddl_tpu.exceptions import (
+    BackendFetchError,
+    IntegrityError,
+    ShutdownRequested,
+)
+from ddl_tpu.faults import fault_point
+
+
+@runtime_checkable
+class StorageBackend(Protocol):
+    """What the cache and the file-based readers need from a shard store.
+
+    Deliberately minimal — two methods.  Whole-shard reads are spelled
+    ``open(path).read()`` by callers; a parallel ``fetch`` method would
+    be a second code path nothing exercises.
+    """
+
+    def open(self, path: str) -> BinaryIO:
+        """Open ``path`` for streaming binary reads (seekable)."""
+        ...
+
+    def fingerprint(self, path: str) -> str:
+        """A cheap content-version fingerprint for ``path``.
+
+        Cache keys embed it (:class:`ddl_tpu.cache.CacheKey`), so a
+        rewritten shard can never alias a stale cached decode.
+        """
+        ...
+
+
+class LocalBackend:
+    """The local filesystem (production default)."""
+
+    name = "local"
+
+    def open(self, path: str) -> BinaryIO:
+        return open(path, "rb")
+
+    def fingerprint(self, path: str) -> str:
+        st = os.stat(path)
+        return f"local:{st.st_size}:{st.st_mtime_ns}"
+
+
+class ThrottledBackend:
+    """A backend wrapper simulating a slow, transiently flaky remote store.
+
+    ``latency_s`` sleeps on every ``open``/``fetch`` (the remote
+    round-trip); ``fail_every=N`` makes every N-th open raise
+    :class:`BackendFetchError` *once* (the retry's next attempt is a new
+    open and passes) — deterministic, so chaos tests can assert exact
+    retry counts.  ``fingerprint`` delegates unchanged: the key must
+    reflect the *content*, not the transport in front of it.
+
+    Picklable (producers ship to PROCESS-mode workers by pickle): the
+    open counter and its lock are per-process state and reset on
+    unpickle.
+    """
+
+    name = "throttled"
+
+    def __init__(
+        self,
+        inner: Optional[StorageBackend] = None,
+        latency_s: float = 0.0,
+        fail_every: int = 0,
+    ):
+        self.inner = inner or LocalBackend()
+        self.latency_s = float(latency_s)
+        self.fail_every = int(fail_every)
+        self._opens = 0
+        self._lock = threading.Lock()
+
+    # -- pickling (locks don't cross the spawn boundary) -------------------
+
+    def __getstate__(self):
+        return {
+            "inner": self.inner,
+            "latency_s": self.latency_s,
+            "fail_every": self.fail_every,
+        }
+
+    def __setstate__(self, state):
+        self.__init__(**state)
+
+    # -- the throttle ------------------------------------------------------
+
+    def _gate(self, path: str) -> None:
+        with self._lock:
+            self._opens += 1
+            n = self._opens
+        if self.fail_every and n % self.fail_every == 0:
+            raise BackendFetchError(
+                f"simulated transient fetch failure for {path!r} "
+                f"(open #{n}, fail_every={self.fail_every})"
+            )
+        if self.latency_s > 0:
+            time.sleep(self.latency_s)
+
+    @property
+    def opens(self) -> int:
+        """Total opens observed (cold-epoch accounting in tests/bench)."""
+        with self._lock:
+            return self._opens
+
+    def open(self, path: str) -> BinaryIO:
+        self._gate(path)
+        return self.inner.open(path)
+
+    def fingerprint(self, path: str) -> str:
+        return self.inner.fingerprint(path)
+
+
+def open_with_retry(
+    backend: StorageBackend,
+    path: str,
+    retries: int = 3,
+    backoff_s: float = 0.05,
+    metrics=None,
+    should_abort=None,
+) -> BinaryIO:
+    """Open ``path`` on ``backend`` with bounded retry + exponential backoff.
+
+    The ONE retry-policy site for shard fetches (producer cold reads,
+    cache-miss refills, warmer prefetches).  Transient failures
+    (:class:`BackendFetchError`, ``OSError``) retry up to ``retries``
+    times with ``backoff_s * 2**attempt`` sleeps; exhaustion raises
+    :class:`IntegrityError` — by then the bytes are provably
+    unfetchable, the terminal rung of the ladder.  Backoff sleeps
+    observe ``should_abort`` so a shutting-down warmer never serves out
+    a full backoff schedule (raises :class:`ShutdownRequested`).
+
+    The ``backend.fetch`` chaos injection point fires before every
+    attempt, so an armed ``BACKEND_FETCH_FAIL`` plan exercises exactly
+    this policy.
+    """
+    attempt = 0
+    while True:
+        if should_abort is not None and should_abort():
+            raise ShutdownRequested(f"fetch of {path!r} aborted")
+        try:
+            fault_point("backend.fetch", should_abort=should_abort)
+            return backend.open(path)
+        except (BackendFetchError, OSError) as e:
+            attempt += 1
+            if metrics is not None:
+                metrics.incr("cache.backend_retries")
+            if attempt > retries:
+                if metrics is not None:
+                    metrics.incr("cache.backend_failures")
+                raise IntegrityError(
+                    f"persistent backend failure fetching {path!r} "
+                    f"({attempt} attempts, backend "
+                    f"{getattr(backend, 'name', type(backend).__name__)}): {e}"
+                ) from e
+            delay = backoff_s * (2 ** (attempt - 1))
+            deadline = time.monotonic() + delay
+            while time.monotonic() < deadline:
+                if should_abort is not None and should_abort():
+                    raise ShutdownRequested(
+                        f"fetch retry backoff for {path!r} aborted"
+                    )
+                time.sleep(min(0.01, delay))

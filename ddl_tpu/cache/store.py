@@ -1,0 +1,525 @@
+"""The multi-tier shard cache: byte-budgeted RAM LRU + verified disk spill.
+
+The paper's producers re-read and re-decode every shard from source on
+every window refill and every epoch — the reference has no storage reuse
+at all.  :class:`CacheStore` closes that gap with two tiers:
+
+- **RAM tier** — an LRU of decoded shard arrays under a byte budget.
+  Entries are stored read-only (``writeable=False``) so a reader that
+  accidentally shuffles a cached array in place fails loudly instead of
+  corrupting every later epoch.
+- **Disk spill tier** — write-through: every insert is also persisted
+  under ``spill_dir`` as an atomic temp-file+``os.replace`` write (a RAM
+  eviction is then just a drop — the bytes are already safe), so the
+  disk tier holds *everything* decoded so far, not only what RAM
+  pressure happened to push out.  Every entry reuses the ring-slot
+  crc32 trailer machinery from :mod:`ddl_tpu.integrity` (payload CRC +
+  a digest-derived ``seq`` tag, :func:`~ddl_tpu.integrity.blob_seq`) and
+  is verified on read: a corrupt or aliased file is **quarantined**
+  (renamed aside, counted) and reported as a miss, so the caller
+  refetches from source — corruption can degrade throughput, never
+  data.  The disk tier survives the process, which is what lets a
+  resumed run warm-start (``LoaderCheckpoint`` records the spill dir).
+
+Keys are content-addressed (:class:`CacheKey`): the source fingerprint
+(size+mtime via the backend), the shard id, the reader class + its
+decode-relevant params, and a transform version — change any of them
+and the digest moves, so stale entries can never alias fresh data.
+
+Observability: ``cache.hits/misses/evictions/spills/spill_hits/
+spill_evictions/quarantined`` counters and ``cache.resident_bytes`` /
+``cache.spill_bytes`` gauges in the shared :class:`Metrics` registry,
+surfaced by ``north_star_report`` and the bench's ``cache`` block.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ddl_tpu import integrity
+from ddl_tpu.faults import fault_point
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+logger = logging.getLogger("ddl_tpu")
+
+#: Bump when the key construction or disk-entry layout changes: old spill
+#: dirs stop matching (checkpoint manifests carry this, so a resumed run
+#: never adopts a tier written under a different schema).
+KEY_SCHEMA_VERSION = 1
+
+#: Disk-entry suffix (``<digest>.ddlc`` under the spill dir).
+SPILL_SUFFIX = ".ddlc"
+#: Quarantined corrupt entries are renamed to ``<digest>.quarantined``
+#: (kept for post-mortem, never re-read).
+QUARANTINE_SUFFIX = ".quarantined"
+#: Only the newest this-many quarantined files are retained — recurring
+#: corruption on a flaky disk must not grow the spill dir without bound
+#: (the exact DDL013 shape, one rung down).
+QUARANTINE_KEEP = 4
+
+_META_LEN_FMT = "<I"
+_META_LEN_BYTES = struct.calcsize(_META_LEN_FMT)
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    """Content-addressed identity of one decoded shard.
+
+    ``source`` is the backend's content fingerprint (size+mtime), not
+    the path — a rewritten shard file gets a new key.  ``shard`` is the
+    shard id (its path).  ``reader`` is the reader class plus every
+    parameter that changes the decoded bytes (``image_size`` for the
+    WebDataset reader, ``feature_key`` for TFRecord).  ``transform`` is
+    the reader's decode-logic version tag, bumped when the decode
+    implementation itself changes shape or content.
+    """
+
+    source: str
+    shard: str
+    reader: str
+    transform: str = ""
+
+    @functools.cached_property
+    def digest(self) -> str:
+        """Hex sha256 over the schema version + every key field."""
+        blob = json.dumps(
+            {
+                "schema": KEY_SCHEMA_VERSION,
+                "source": self.source,
+                "shard": self.shard,
+                "reader": self.reader,
+                "transform": self.transform,
+            },
+            sort_keys=True,
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+
+class CacheStore:
+    """Byte-budgeted RAM LRU over an integrity-checked disk spill tier.
+
+    Thread-safe (one RLock): producer threads, the background warmer and
+    the consumer may all hit one store.  Not picklable — PROCESS-mode
+    workers each build their own from the ``DDL_TPU_CACHE*`` environment
+    (:func:`ddl_tpu.cache.default_store`); passing a store instance into
+    a producer constructor is the THREAD-mode / test path.
+    """
+
+    def __init__(
+        self,
+        ram_budget_bytes: int = 256 << 20,
+        spill_dir: Optional[str] = None,
+        spill_budget_bytes: int = 1 << 30,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.ram_budget_bytes = int(ram_budget_bytes)
+        self.spill_dir = os.path.abspath(spill_dir) if spill_dir else None
+        self.spill_budget_bytes = int(spill_budget_bytes)
+        self.metrics = metrics or default_metrics()
+        # Two locks so a pure RAM-tier hit never waits on disk I/O:
+        # _lock guards the LRU bookkeeping only; _spill_lock serializes
+        # disk-tier writes/trims/quarantines and their accounting.
+        # Order (also declared in [tool.ddl_lint] lock_order): _lock may
+        # be held when _spill_lock is taken (eviction spill-backstop),
+        # never the reverse.
+        self._lock = threading.RLock()
+        self._spill_lock = threading.Lock()
+        # LRU: digest -> read-only decoded array; bounded by the byte
+        # budget via _evict_over_budget (DDL013's whole point).
+        self._ram: "collections.OrderedDict[str, np.ndarray]" = (
+            collections.OrderedDict()
+        )
+        self._ram_bytes = 0
+        self._spill_bytes = 0
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            # Warm start: adopt whatever a previous run spilled (resume
+            # path — keys are content-addressed, so stale files simply
+            # never match; over-budget remnants trim on first spill).
+            self._spill_bytes = self._scan_spill_bytes()
+            self.metrics.set_gauge("cache.spill_bytes", self._spill_bytes)
+
+    def _scan_spill_bytes(self) -> int:
+        total = 0
+        for name in os.listdir(self.spill_dir):
+            if name.endswith(SPILL_SUFFIX):
+                try:
+                    total += os.path.getsize(
+                        os.path.join(self.spill_dir, name)
+                    )
+                except OSError:
+                    pass
+        return total
+
+    def attach_spill_dir(self, spill_dir: str) -> bool:
+        """Late-bind a disk tier onto a RAM-only store.
+
+        The checkpoint-manifest adoption path for an ALREADY-BUILT store:
+        THREAD-mode resume applies the loader checkpoint after the
+        loader (and with it the shared process store) exists, so the
+        manifest must be attachable in place.  Existing entries in the
+        directory are adopted (content-addressed keys make that safe).
+        Refused when a *different* spill dir is already attached —
+        adoption never silently re-routes a live tier.
+        """
+        spill_dir = os.path.abspath(spill_dir)
+        with self._spill_lock:
+            if self.spill_dir is not None:
+                return self.spill_dir == spill_dir
+            try:
+                os.makedirs(spill_dir, exist_ok=True)
+            except OSError:
+                return False
+            self.spill_dir = spill_dir
+            self._spill_bytes = self._scan_spill_bytes()
+            self.metrics.set_gauge("cache.spill_bytes", self._spill_bytes)
+        return True
+
+    def __deepcopy__(self, memo) -> "CacheStore":
+        # THREAD-mode channels deep-copy shipped producer functions to
+        # simulate the process boundary; the store is deliberately
+        # SHARED process state (one RAM tier per host, all thread
+        # producers hitting it), so the copy is the instance.  PROCESS
+        # mode must not ship stores at all — pickling one fails loudly
+        # (locks don't pickle) and workers build their own from the
+        # environment instead (``default_store``).
+        return self
+
+    # -- public API --------------------------------------------------------
+
+    def get(self, key: CacheKey) -> Optional[np.ndarray]:
+        """RAM tier, then disk tier; ``None`` on miss (caller refetches).
+
+        A disk hit is verified (CRC + digest-derived seq) and promoted
+        into the RAM tier; a corrupt disk entry is quarantined and
+        reported as a miss — the degradation ladder's first rung.  The
+        disk read/verify runs OUTSIDE the LRU lock (entries publish
+        atomically and are content-addressed, so unlocked I/O is safe):
+        one thread's multi-hundred-MB disk promote never stalls another
+        thread's RAM hit.
+        """
+        digest = key.digest
+        with self._lock:
+            arr = self._ram.get(digest)
+            if arr is not None:
+                self._ram.move_to_end(digest)
+                self.metrics.incr("cache.hits")
+                return arr
+        arr = self._disk_get(digest)
+        if arr is not None:
+            self.metrics.incr("cache.hits")
+            self.metrics.incr("cache.spill_hits")
+            with self._lock:
+                return self._insert(digest, arr, from_disk=True)
+        self.metrics.incr("cache.misses")
+        return None
+
+    def put(self, key: CacheKey, arr: np.ndarray) -> np.ndarray:
+        """Insert ``arr`` under ``key``; returns the stored (read-only)
+        array — callers should use the return value so every consumer
+        shares one resident copy.
+
+        The store takes OWNERSHIP of ``arr``: it is marked read-only in
+        place (when already contiguous, no copy is made — the caller's
+        reference and the resident entry are the same object).  Pass a
+        copy if you need to keep mutating your buffer; the in-tree
+        readers always hand over freshly decoded arrays.  The
+        write-through disk persist also runs outside the LRU lock.
+        """
+        digest = key.digest
+        with self._lock:
+            existing = self._ram.get(digest)
+            if existing is not None:
+                self._ram.move_to_end(digest)
+                return existing
+        arr = np.ascontiguousarray(arr)
+        arr.setflags(write=False)
+        self._spill(digest, arr)
+        with self._lock:
+            existing = self._ram.get(digest)
+            if existing is not None:  # raced another inserter: share theirs
+                self._ram.move_to_end(digest)
+                return existing
+            return self._insert(digest, arr, persisted=True)
+
+    def get_or_load(
+        self, key: CacheKey, loader: Callable[[], np.ndarray]
+    ) -> np.ndarray:
+        """``get`` or fetch-decode-insert via ``loader`` on miss."""
+        arr = self.get(key)
+        if arr is None:
+            arr = self.put(key, loader())
+        return arr
+
+    def contains(self, key: CacheKey) -> bool:
+        """Tier membership WITHOUT touching hit/miss counters (the
+        warmer's skip-already-warm probe must not skew the ratios the
+        bench reports)."""
+        digest = key.digest
+        with self._lock:
+            if digest in self._ram:
+                return True
+        return bool(
+            self.spill_dir
+            and os.path.exists(self._spill_path(digest))
+        )
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._ram_bytes
+
+    def stats(self) -> Dict[str, float]:
+        """Point-in-time tier sizes (counters live in ``self.metrics``)."""
+        with self._lock:
+            return {
+                "entries": float(len(self._ram)),
+                "resident_bytes": float(self._ram_bytes),
+                "spill_bytes": float(self._spill_bytes),
+            }
+
+    def clear(self) -> None:
+        """Drop the RAM tier (disk entries stay — they re-verify on read)."""
+        with self._lock:
+            self._ram.clear()
+            self._ram_bytes = 0
+            self.metrics.set_gauge("cache.resident_bytes", 0)
+
+    # -- RAM tier ----------------------------------------------------------
+
+    def _insert(
+        self,
+        digest: str,
+        arr: np.ndarray,
+        from_disk: bool = False,
+        persisted: bool = False,
+    ) -> np.ndarray:
+        # Caller holds _lock.  Re-check residency FIRST: two threads can
+        # race a miss on the same digest (e.g. concurrent disk promotes,
+        # or a promote racing a put) and both reach here — inserting
+        # twice would overwrite the entry but add its nbytes to
+        # _ram_bytes twice, permanently shrinking the effective budget.
+        existing = self._ram.get(digest)
+        if existing is not None:
+            self._ram.move_to_end(digest)
+            return existing
+        # Read-only residents: an in-place shuffle on a cached array
+        # would silently corrupt every later epoch's "hit".
+        arr.setflags(write=False)
+        # Write-through (no-op without a spill dir, for an entry that
+        # came FROM disk, or one ``put`` already persisted pre-lock):
+        # once written, a later RAM eviction is a pure drop and a
+        # process exit loses nothing the manifest points at.
+        if not from_disk and not persisted:
+            self._spill(digest, arr)
+        if arr.nbytes > self.ram_budget_bytes:
+            # Oversized for the RAM tier entirely: disk-only residency.
+            return arr
+        self._ram[digest] = arr
+        self._ram_bytes += arr.nbytes
+        self._evict_over_budget()
+        self.metrics.set_gauge("cache.resident_bytes", self._ram_bytes)
+        return arr
+
+    def _evict_over_budget(self) -> None:
+        while self._ram_bytes > self.ram_budget_bytes and len(self._ram) > 1:
+            old_digest, old = self._ram.popitem(last=False)
+            self._ram_bytes -= old.nbytes
+            self.metrics.incr("cache.evictions")
+            # Backstop only: write-through already persisted the entry
+            # at insert (the exists-check makes this a stat), but an
+            # insert whose spill failed transiently gets a second try.
+            self._spill(old_digest, old)
+
+    # -- disk tier ---------------------------------------------------------
+
+    def _spill_path(self, digest: str) -> str:
+        return os.path.join(self.spill_dir or "", digest + SPILL_SUFFIX)
+
+    def _spill(self, digest: str, arr: np.ndarray) -> None:
+        if not self.spill_dir:
+            return
+        path = self._spill_path(digest)
+        if os.path.exists(path):
+            return  # content-addressed: same digest == same bytes
+        meta = json.dumps(
+            {
+                "schema": KEY_SCHEMA_VERSION,
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+            }
+        ).encode()
+        payload = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+        off = _META_LEN_BYTES + len(meta)
+        total = off + payload.nbytes + integrity.HEADER_BYTES
+        if total > self.spill_budget_bytes:
+            # Oversized for the whole tier (symmetric to the RAM tier's
+            # guard): writing it would only make the trim below evict
+            # every valid entry AND the new file itself, every miss.
+            logger.warning(
+                "cache: entry %s… (%d bytes) exceeds the spill budget "
+                "(%d); not persisted",
+                digest[:12], total, self.spill_budget_bytes,
+            )
+            return
+        blob = np.empty(total, np.uint8)
+        blob[:_META_LEN_BYTES] = np.frombuffer(
+            struct.pack(_META_LEN_FMT, len(meta)), np.uint8
+        )
+        blob[_META_LEN_BYTES:off] = np.frombuffer(meta, np.uint8)
+        blob[off : off + payload.nbytes] = payload
+        integrity.write_header(
+            blob[off:],
+            payload.nbytes,
+            seq=integrity.blob_seq(digest),
+            producer_idx=0,
+            crc=integrity.window_crc(payload),
+        )
+        # Atomic publish: a crash mid-write leaves only a temp file a
+        # later run ignores; readers can never observe a torn entry.
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with self._spill_lock:
+            if os.path.exists(path):
+                # Re-check under the lock: a concurrent insert of the
+                # same digest won the race between the cheap unlocked
+                # check above and here — writing again would be
+                # harmless (same bytes) but would double-count
+                # _spill_bytes and trigger phantom trims.
+                return
+            try:
+                blob.tofile(tmp)
+                os.replace(tmp, path)
+            except OSError as e:
+                logger.warning(
+                    "cache: spill of %s failed: %s", digest[:12], e
+                )
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return
+            self._spill_bytes += blob.nbytes
+            self.metrics.incr("cache.spills")
+            self._trim_spill_tier()
+            self.metrics.set_gauge("cache.spill_bytes", self._spill_bytes)
+
+    def _trim_spill_tier(self) -> None:
+        """Oldest-first disk eviction when the spill tier is over budget
+        (caller holds ``_spill_lock``)."""
+        if not self.spill_dir or self._spill_bytes <= self.spill_budget_bytes:
+            return
+        entries = []
+        for name in os.listdir(self.spill_dir):
+            if not name.endswith(SPILL_SUFFIX):
+                continue
+            p = os.path.join(self.spill_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime_ns, st.st_size, p))
+        entries.sort()
+        for _, size, p in entries:
+            if self._spill_bytes <= self.spill_budget_bytes:
+                break
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            self._spill_bytes -= size
+            self.metrics.incr("cache.spill_evictions")
+
+    def _disk_get(self, digest: str) -> Optional[np.ndarray]:
+        if not self.spill_dir:
+            return None
+        path = self._spill_path(digest)
+        try:
+            raw = np.fromfile(path, np.uint8)
+        except (OSError, FileNotFoundError):
+            return None
+        # Chaos hook: flips bytes in the just-read entry, exercising the
+        # quarantine-and-refetch rung below exactly as at-rest disk
+        # corruption would.
+        fault_point("cache.disk_read", view=raw)
+        try:
+            if len(raw) < _META_LEN_BYTES:
+                raise ValueError("short entry (no meta length)")
+            (meta_len,) = struct.unpack(
+                _META_LEN_FMT, raw[:_META_LEN_BYTES].tobytes()
+            )
+            off = _META_LEN_BYTES + meta_len
+            payload_bytes = len(raw) - off - integrity.HEADER_BYTES
+            if meta_len <= 0 or payload_bytes < 0:
+                raise ValueError("truncated entry")
+            meta = json.loads(raw[_META_LEN_BYTES:off].tobytes())
+            if meta.get("schema") != KEY_SCHEMA_VERSION:
+                raise ValueError(f"key-schema {meta.get('schema')} entry")
+            err = integrity.verify_window(
+                raw[off:],
+                payload_bytes,
+                expect_seq=integrity.blob_seq(digest),
+                expect_producer=0,
+            )
+            if err:
+                raise ValueError(err)
+            arr = (
+                raw[off : off + payload_bytes]
+                .view(np.dtype(meta["dtype"]))
+                .reshape(meta["shape"])
+            )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._quarantine(path, digest, str(e))
+            return None
+        return arr
+
+    def _quarantine(self, path: str, digest: str, reason: str) -> None:
+        """Move a corrupt disk entry aside (kept for post-mortem, never
+        re-read) and count it; the caller reports a miss and the reader
+        refetches from source."""
+        logger.warning(
+            "cache: quarantining corrupt disk entry %s…: %s",
+            digest[:12], reason,
+        )
+        self.metrics.incr("cache.quarantined")
+        with self._spill_lock:
+            try:
+                size = os.path.getsize(path)
+                os.replace(
+                    path, path[: -len(SPILL_SUFFIX)] + QUARANTINE_SUFFIX
+                )
+                self._spill_bytes = max(0, self._spill_bytes - size)
+                self.metrics.set_gauge(
+                    "cache.spill_bytes", self._spill_bytes
+                )
+            except OSError:
+                pass
+            # Retention bound: keep only the newest QUARANTINE_KEEP
+            # post-mortem files (they live outside the budget
+            # accounting, so without this a flaky disk grows the
+            # directory forever).
+            q = []
+            for name in os.listdir(self.spill_dir or ""):
+                if not name.endswith(QUARANTINE_SUFFIX):
+                    continue
+                p = os.path.join(self.spill_dir, name)
+                try:
+                    q.append((os.stat(p).st_mtime_ns, p))
+                except OSError:
+                    continue
+            q.sort()
+            for _, p in q[: max(0, len(q) - QUARANTINE_KEEP)]:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass

@@ -1,0 +1,126 @@
+"""Background cache warmer: prefetch upcoming shards off the fill path.
+
+The same overlap-the-slow-path rationale as MPMD pipelining applied to
+storage: shard fetch+decode latency should be hidden behind the warm
+tier, not paid on the producer's window-refill path.  A
+:class:`CacheWarmer` walks the reader's shard list **in epoch order**
+(the order refills will ask for them) on one daemon thread, loading
+whatever is not yet cached until a byte budget is spent.
+
+Shutdown contract (the part that usually rots): ``close()`` sets a stop
+event and joins with a bound.  The loop checks the event between jobs,
+every loader is handed a ``should_abort`` callback so a prefetch blocked
+in backend retry/backoff aborts promptly
+(:class:`~ddl_tpu.exceptions.ShutdownRequested` propagates out of
+:func:`~ddl_tpu.cache.backends.open_with_retry`), and the thread treats
+that signal as a clean exit — no leaked threads, no stranded sleeps.
+Warming is best-effort by design: any other loader failure logs and
+skips that shard (the fill path will retry it with the full ladder).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ddl_tpu.cache.store import CacheKey, CacheStore
+from ddl_tpu.exceptions import ShutdownRequested
+
+logger = logging.getLogger("ddl_tpu")
+
+#: One prefetch job: the entry's key — either a literal :class:`CacheKey`
+#: or a zero-arg thunk producing one, resolved ON the warmer thread
+#: (key construction can stat/round-trip the backend for a fingerprint;
+#: a thousand-shard list must not pay that on the producer's init path)
+#: — plus a loader called as ``loader(should_abort)`` returning the
+#: decoded shard array.
+WarmJob = Tuple[
+    Union[CacheKey, Callable[[], CacheKey]],
+    Callable[[Callable[[], bool]], np.ndarray],
+]
+
+
+class CacheWarmer:
+    """Prefetch ``jobs`` into ``store`` on a background daemon thread.
+
+    ``budget_bytes`` bounds how much the warmer itself loads (defaults
+    to the store's RAM budget — warming past it would only evict what
+    was just warmed).  Already-cached entries are skipped via
+    ``store.contains`` (no hit/miss skew).
+    """
+
+    def __init__(
+        self,
+        store: CacheStore,
+        jobs: Sequence[WarmJob],
+        budget_bytes: Optional[int] = None,
+        name: str = "ddl-cache-warmer",
+    ):
+        self._store = store
+        self._jobs = list(jobs)
+        self._budget = (
+            store.ram_budget_bytes if budget_bytes is None else int(budget_bytes)
+        )
+        self._stop = threading.Event()
+        self._warmed_bytes = 0
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # -- introspection -----------------------------------------------------
+
+    def should_abort(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    @property
+    def warmed_bytes(self) -> int:
+        return self._warmed_bytes
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            for key_ref, loader in self._jobs:
+                if self._stop.is_set():
+                    raise ShutdownRequested("cache warmer stopped")
+                if self._warmed_bytes >= self._budget:
+                    logger.debug(
+                        "cache warmer: budget spent (%d bytes), stopping",
+                        self._warmed_bytes,
+                    )
+                    break
+                try:
+                    key = key_ref() if callable(key_ref) else key_ref
+                    if self._store.contains(key):
+                        continue
+                    arr = loader(self._stop.is_set)
+                except ShutdownRequested:
+                    raise
+                except Exception:
+                    # Best-effort: the fill path will retry this shard
+                    # with the full retry/quarantine ladder and its own
+                    # error surfacing; the warmer just moves on.
+                    logger.exception(
+                        "cache warmer: prefetch failed; shard left cold"
+                    )
+                    continue
+                self._store.put(key, arr)
+                self._warmed_bytes += int(arr.nbytes)
+                self._store.metrics.incr("cache.warmed")
+        except ShutdownRequested:
+            logger.debug("cache warmer: clean shutdown mid-prefetch")
+
+    def close(self, timeout_s: float = 10.0) -> bool:
+        """Stop and join (bounded).  Returns True when the thread exited
+        within the bound; idempotent."""
+        self._stop.set()
+        self._thread.join(timeout_s)
+        return not self._thread.is_alive()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from typing import Any, Optional
 
@@ -75,6 +76,31 @@ def restore_train_state(path: str, like: TrainState) -> TrainState:
     )
 
 
+def adopt_cache_manifest(path: str) -> bool:
+    """Pre-spawn cache warm-start: read ONLY the cache manifest from the
+    loader checkpoint at ``path`` and adopt it.
+
+    PROCESS/MULTIHOST producer workers inherit their environment at
+    spawn, so for them the manifest must be adopted **before**
+    ``distributed_dataloader`` builds the worker set — call this at the
+    top of a resuming main, before the decorator runs.  (THREAD mode
+    does not need it: ``LoaderCheckpoint.apply`` attaches the tier to
+    the live shared store.)  Returns False — resuming with a cold
+    cache, never an error — when the checkpoint is missing/unreadable,
+    carries no manifest, or the manifest is refused (schema mismatch,
+    vanished directory, conflicting live tier).
+    """
+    try:
+        ck = LoaderCheckpoint.load(path)
+    except (OSError, ValueError, TypeError, KeyError):
+        return False
+    if not ck.cache_spill_dir:
+        return False
+    from ddl_tpu import cache as cache_mod
+
+    return cache_mod.adopt_manifest(ck.cache_spill_dir, ck.cache_key_schema)
+
+
 @dataclasses.dataclass
 class LoaderCheckpoint:
     """The loader's logical position (enough to resume deterministically).
@@ -90,9 +116,21 @@ class LoaderCheckpoint:
     target: int = 0
     batches_in_window: int = 0
     shuffle_round: int = 0
+    #: Cache manifest (ISSUE 4): the shard cache's disk-tier directory
+    #: plus the key-schema version it was written under.  ``apply``
+    #: points the resumed run's cache at this spill dir
+    #: (:func:`ddl_tpu.cache.adopt_manifest`), so epoch-1-after-resume
+    #: reads decoded shards from disk instead of refetching from source.
+    #: A schema mismatch is refused — content-addressed keys make stale
+    #: entries unmatchable anyway, but a refused adoption is cheaper
+    #: than a tier of guaranteed misses.
+    cache_spill_dir: Optional[str] = None
+    cache_key_schema: int = 0
 
     @staticmethod
-    def capture(loader: Any, shuffler: Any = None) -> "LoaderCheckpoint":
+    def capture(
+        loader: Any, shuffler: Any = None, cache: Any = None
+    ) -> "LoaderCheckpoint":
         round_ = 0
         if shuffler is not None:
             # Public accessor first (the rejoin/exchange_round contract);
@@ -101,17 +139,38 @@ class LoaderCheckpoint:
             round_ = getattr(
                 shuffler, "exchange_round", getattr(shuffler, "_round", 0)
             )
+        from ddl_tpu import cache as cache_mod
+
+        # The active store only — capture must not build a store (or
+        # decide cache policy) as a side effect of checkpointing.
+        store = cache if cache is not None else cache_mod.active_store()
+        spill = getattr(store, "spill_dir", None) if store else None
         return LoaderCheckpoint(
             epoch=loader._epoch,
             target=loader._target,
             batches_in_window=loader._batches_in_window,
             shuffle_round=int(round_),
+            cache_spill_dir=spill,
+            cache_key_schema=(
+                cache_mod.KEY_SCHEMA_VERSION if spill else 0
+            ),
         )
 
     def apply(self, loader: Any, shuffler: Any = None) -> None:
         loader._epoch = self.epoch
         loader._target = self.target
         loader._batches_in_window = self.batches_in_window
+        if self.cache_spill_dir:
+            from ddl_tpu import cache as cache_mod
+
+            if not cache_mod.adopt_manifest(
+                self.cache_spill_dir, self.cache_key_schema
+            ):
+                logging.getLogger("ddl_tpu").warning(
+                    "checkpoint cache manifest not adopted (%s, schema %d)"
+                    " — resuming with a cold cache",
+                    self.cache_spill_dir, self.cache_key_schema,
+                )
         if shuffler is not None:
             rejoin = getattr(shuffler, "rejoin", None)
             if callable(rejoin):

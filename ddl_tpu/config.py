@@ -43,6 +43,15 @@ class LoaderConfig:
     # checkpointing
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 0  # 0 = disabled
+    # shard cache (ddl_tpu.cache; docs/CACHING.md).  Mirrors the
+    # DDL_TPU_CACHE* env knobs — distributed_dataloader exports these
+    # fields back into the environment so PROCESS-mode producer workers
+    # build the same store.
+    cache: bool = False
+    cache_ram_mb: int = 256
+    cache_spill_dir: Optional[str] = None
+    cache_spill_mb: int = 1024
+    cache_warm: bool = True
 
     _ENV_PREFIX = "DDL_TPU_"
 

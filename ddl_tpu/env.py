@@ -17,7 +17,12 @@ producer workers:
   of the reference's SLURM sniffing (``ddl_env.py:103-107``).
 
 Environment knobs (the reference used SLURM vars): ``DDL_TPU_MODE``,
-``DDL_TPU_N_PRODUCERS``, ``DDL_TPU_NSLOTS``.
+``DDL_TPU_N_PRODUCERS``, ``DDL_TPU_NSLOTS``; plus the shard-cache set
+``DDL_TPU_CACHE`` / ``DDL_TPU_CACHE_RAM_MB`` / ``DDL_TPU_CACHE_SPILL_DIR``
+/ ``DDL_TPU_CACHE_SPILL_MB`` / ``DDL_TPU_CACHE_WARM`` (parsed in
+:mod:`ddl_tpu.cache`, mirrored by ``LoaderConfig`` fields, and exported
+by :func:`_export_cache_knobs` ahead of the producer spawn so
+PROCESS/MULTIHOST workers build the same store).
 """
 
 from __future__ import annotations
@@ -168,6 +173,40 @@ def _process_entry(
     _producer_main(
         conn, topology, producer_idx, nslots, shuffler_factory, rejoin_ring
     )
+
+
+def _export_cache_knobs(config: Any) -> None:
+    """Mirror a LoaderConfig's shard-cache fields into the ``DDL_TPU_CACHE*``
+    environment BEFORE producers spawn.
+
+    The cache store is per process (``ddl_tpu.cache.default_store``):
+    THREAD-mode workers share the consumer's, but PROCESS/MULTIHOST
+    workers each build their own from the environment they inherit —
+    without this export a config-enabled cache would silently apply to
+    nobody in the modes that need it most.
+
+    The mirror goes BOTH ways (config wins over env, the documented
+    precedence): a config with ``cache=False`` exports the gate as off,
+    and a cache-on config with no spill dir clears any stale
+    ``DDL_TPU_CACHE_SPILL_DIR`` — otherwise a second run in the same
+    process would silently inherit the previous run's export.  A bare
+    ``config=None`` call states no cache opinion and leaves the
+    environment (a first-class interface of its own) untouched.
+    """
+    if config is None:
+        return
+    if not getattr(config, "cache", False):
+        if "DDL_TPU_CACHE" in os.environ:
+            os.environ["DDL_TPU_CACHE"] = "0"
+        return
+    os.environ["DDL_TPU_CACHE"] = "1"
+    os.environ["DDL_TPU_CACHE_RAM_MB"] = str(config.cache_ram_mb)
+    os.environ["DDL_TPU_CACHE_SPILL_MB"] = str(config.cache_spill_mb)
+    os.environ["DDL_TPU_CACHE_WARM"] = "1" if config.cache_warm else "0"
+    if config.cache_spill_dir:
+        os.environ["DDL_TPU_CACHE_SPILL_DIR"] = config.cache_spill_dir
+    else:
+        os.environ.pop("DDL_TPU_CACHE_SPILL_DIR", None)
 
 
 class WorkerSet:
@@ -342,6 +381,7 @@ def distributed_dataloader(
         def wrapper(*args: Any, **kwargs: Any) -> Any:
             topology = detect_topology(n_producers, mode)
             depth = nslots or int(os.environ.get("DDL_TPU_NSLOTS", "2"))
+            _export_cache_knobs(config)
             workers = WorkerSet(topology, depth, shuffler_factory)
             env = DDL_Env(
                 topology=topology, connection=workers.connection,

@@ -72,6 +72,20 @@ class IntegrityError(DDLError):
     """
 
 
+class BackendFetchError(TransportError):
+    """A storage-backend shard fetch failed (transient until proven not).
+
+    Raised by :class:`ddl_tpu.cache.StorageBackend` implementations (and
+    the ``backend.fetch`` fault-injection point) when a shard read fails
+    in a way a retry might heal — the remote-store analog of a dropped
+    connection.  The one retry-policy site,
+    :func:`ddl_tpu.cache.open_with_retry`, catches it with bounded
+    exponential backoff; exhaustion escalates to :class:`IntegrityError`
+    (a *persistent* backend failure is a data-availability fault, not a
+    transport hiccup).
+    """
+
+
 class InjectedFault(DDLError):
     """A deliberate failure raised by the fault-injection engine.
 

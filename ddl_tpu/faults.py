@@ -38,6 +38,13 @@ Injection points shipped today (site — fault kinds that act there):
 ``staging.transfer``      staged-transfer failure / timeout (delay)
 ``shuffle.exchange``      peer loss (partner never posts its half)
 ``watchdog.sweep``        spurious shutdown / crash inside ``check_once``
+``cache.disk_read``       cache-entry corruption (bytes flipped in a
+                          just-read disk-tier entry, BEFORE verification —
+                          exercises quarantine-and-refetch)
+``backend.fetch``         storage-backend fetch failure (transient under
+                          the retry budget; persistent beyond it →
+                          ``IntegrityError``), fired inside
+                          ``cache.open_with_retry`` before every attempt
 ========================  ====================================================
 """
 
@@ -51,7 +58,12 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ddl_tpu.exceptions import DDLError, InjectedFault, ShutdownRequested
+from ddl_tpu.exceptions import (
+    BackendFetchError,
+    DDLError,
+    InjectedFault,
+    ShutdownRequested,
+)
 
 
 class FaultKind(enum.Enum):
@@ -66,6 +78,8 @@ class FaultKind(enum.Enum):
     STAGED_TRANSFER_TIMEOUT = "staged_transfer_timeout"
     SHUFFLE_PEER_LOSS = "shuffle_peer_loss"
     SPURIOUS_SHUTDOWN = "spurious_shutdown"
+    CACHE_CORRUPTION = "cache_corruption"
+    BACKEND_FETCH_FAIL = "backend_fetch_fail"
 
 
 @dataclasses.dataclass
@@ -125,7 +139,9 @@ class FaultPlan:
         self.seed = int(seed)
         self.fired: List[Tuple[str, str, Optional[int], int]] = []
         self._lock = threading.Lock()
-        self._hits: Dict[int, int] = {}  # spec index -> matching hits
+        # spec index -> matching hits: bounded by len(specs) by
+        # construction (indices come only from enumerate(self.specs)).
+        self._hits: Dict[int, int] = {}  # ddl-lint: disable=DDL013
         import numpy as np
 
         self._rng = np.random.default_rng(self.seed)
@@ -200,7 +216,10 @@ class FaultPlan:
             FaultKind.STAGED_TRANSFER_TIMEOUT,
         ):
             time.sleep(spec.param or 0.2)
-        elif kind is FaultKind.RING_CORRUPTION:
+        elif kind in (
+            FaultKind.RING_CORRUPTION,
+            FaultKind.CACHE_CORRUPTION,
+        ):
             if view is None or len(view) == 0:
                 return  # site carries no mutable payload; nothing to flip
             nbytes = max(1, int(spec.param))
@@ -213,6 +232,12 @@ class FaultPlan:
             FaultKind.STAGED_TRANSFER_FAIL,
         ):
             raise InjectedFault(f"{kind.value} {where}")
+        elif kind is FaultKind.BACKEND_FETCH_FAIL:
+            # Raised as the REAL transient type, not InjectedFault: the
+            # production retry/backoff ladder in cache.open_with_retry
+            # must handle it exactly as it would a live remote-store
+            # hiccup (that ladder is what the injection tests).
+            raise BackendFetchError(f"backend fetch failure {where}")
         elif kind is FaultKind.SHUFFLE_PEER_LOSS:
             raise DDLError(f"shuffle peer loss {where}")
         else:  # pragma: no cover - FaultKind is closed above

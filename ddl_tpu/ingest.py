@@ -345,6 +345,20 @@ def north_star_report(
     report["shuffle_degraded"] = m.counter("shuffle.degraded")
     report["staging_retries"] = m.counter("staging.retries")
     report["inline_fallbacks"] = m.counter("staging.inline_fallbacks")
+    # Shard-cache observability (ddl_tpu.cache, ISSUE 4): the warm tier's
+    # effectiveness (hit ratio), pressure (evictions/spills), and health
+    # (quarantines = corrupt disk entries healed by refetch) belong in
+    # the same report the bench JSON charts — a run whose "warm" epochs
+    # quietly missed every shard is a perf regression, and one that
+    # quarantined entries deserves a look even when throughput held.
+    report["cache_hits"] = m.counter("cache.hits")
+    report["cache_misses"] = m.counter("cache.misses")
+    report["cache_evictions"] = m.counter("cache.evictions")
+    report["cache_spills"] = m.counter("cache.spills")
+    report["cache_spill_hits"] = m.counter("cache.spill_hits")
+    report["cache_quarantined"] = m.counter("cache.quarantined")
+    report["cache_resident_bytes"] = m.gauge("cache.resident_bytes")
+    report["cache_resident_bytes_max"] = m.gauge("cache.resident_bytes.max")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

@@ -74,6 +74,19 @@ class WindowHeader:
         return self.magic == _MAGIC
 
 
+def blob_seq(digest: str) -> int:
+    """Stable 64-bit sequence tag derived from a cache-entry digest.
+
+    The disk cache tier (``ddl_tpu/cache/store.py``) reuses the ring-slot
+    trailer machinery above for its on-disk entries, with this digest-
+    derived value in the header's ``seq`` field: a spill file renamed or
+    hard-linked across keys then fails :func:`verify_window`'s sequence
+    check even when its payload CRC is intact — stale entries can never
+    alias a foreign key.
+    """
+    return int(digest[:16], 16) & 0xFFFFFFFFFFFFFFFF
+
+
 def write_header(
     slot_view: np.ndarray,
     payload_bytes: int,

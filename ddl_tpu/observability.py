@@ -5,6 +5,16 @@ The rebuild's north-star metrics (samples/sec/host ingest, input-pipeline
 stall %, H2D bandwidth utilisation — BASELINE.md) need first-class
 instrumentation, so every pipeline component records into a shared
 :class:`Metrics` registry that the benchmark suite and user code can read.
+
+Well-known name families (each component documents its own; the bench
+JSON contract in ``tools/bench_smoke.py`` pins the load-bearing ones):
+``consumer.*`` / ``ingest.*`` (drain + device feed), ``staging.*`` (the
+staged-ingest engine), ``watchdog.*`` / ``integrity.*`` / ``shuffle.*``
+(robustness events), and ``cache.*`` (the shard cache —
+``cache.hits/misses/evictions/spills/spill_hits/spill_evictions/
+quarantined/warmed/backend_retries/backend_failures`` counters plus
+``cache.resident_bytes`` / ``cache.spill_bytes`` gauges, whose ``.max``
+high-water marks ride along automatically).
 """
 
 from __future__ import annotations
@@ -95,6 +105,24 @@ class Metrics:
                 out[f"{k}.count"] = float(t.count)
             out.update(self._gauges)
             out["elapsed_s"] = time.perf_counter() - self._t0
+            return out
+
+    def prefixed(self, prefix: str) -> Dict[str, float]:
+        """Counters + gauges under one name family (``prefix`` up to and
+        including its trailing dot, e.g. ``"cache."``), keys stripped of
+        the prefix — the bench assembles its per-subsystem JSON blocks
+        from this instead of hand-listing every counter."""
+        with self._lock:
+            out: Dict[str, float] = {
+                k[len(prefix):]: v
+                for k, v in self._counters.items()
+                if k.startswith(prefix)
+            }
+            out.update(
+                (k[len(prefix):], v)
+                for k, v in self._gauges.items()
+                if k.startswith(prefix)
+            )
             return out
 
     # Derived north-star metrics -------------------------------------------

@@ -8,12 +8,19 @@ driver's scale-out configs (BASELINE.json): in-memory arrays (the
 streams for LLM pretraining (C4/Llama feed, configs[3-4]).  All shard
 deterministically by ``(instance_idx, producer_idx)`` the way the
 reference example sliced per instance (reference ``tests/run_ddl.py:84-87``).
+
+The file-based readers fetch shard bytes through the pluggable storage
+backends in :mod:`ddl_tpu.cache` and keep decoded shards in the
+multi-tier shard cache when it is enabled (``DDL_TPU_CACHE=1`` or an
+explicit ``cache=`` store) — epoch ≥ 2 then skips fetch *and* decode,
+and a background warmer prefetches upcoming shards in epoch order.  See
+:class:`_ShardCacheMixin` and docs/CACHING.md.
 """
 
 from __future__ import annotations
 
 import glob as glob_mod
-from typing import Any, Optional, Sequence
+from typing import Any, BinaryIO, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -44,6 +51,146 @@ def _glob_my_shards(pattern: str, producer_idx: int, n_producers: int,
             f"one per worker ({n_instances * n_producers} workers)"
         )
     return [paths[i] for i in mine]
+
+
+class _ShardCacheMixin:
+    """Cache/backend plumbing shared by the shard-file producers.
+
+    Every shard byte these producers touch goes through a pluggable
+    :class:`~ddl_tpu.cache.StorageBackend` (``backend=`` constructor
+    kwarg; default the local filesystem) with bounded retry/backoff, and
+    — when the cache is enabled — decoded shards are kept in a
+    :class:`~ddl_tpu.cache.CacheStore` keyed by content-addressed
+    ``(source fingerprint, shard, reader class + params, transform
+    version)`` keys, so epoch ≥ 2 skips both the fetch and the decode.
+    A background :class:`~ddl_tpu.cache.CacheWarmer` prefetches this
+    worker's shard list in epoch order; the ``on_push_end`` hook (run in
+    ``DataPusher.push_data``'s ``finally``) closes it with a bounded
+    join, so no run leaks a warmer thread.
+
+    Cache resolution (worker-side, in ``on_init``): an explicit
+    ``cache=`` store wins (THREAD mode / tests — a ``CacheStore`` does
+    not pickle across the PROCESS spawn boundary); otherwise the
+    ``DDL_TPU_CACHE`` gate selects the process-default store built from
+    the environment, which PROCESS workers inherit.
+
+    Subclasses set ``transform_version`` (bump when decode output
+    changes) and override ``_reader_params`` with every constructor
+    parameter that changes decoded bytes.
+    """
+
+    #: Decode-logic version tag: part of the cache key, so bumping it
+    #: orphans (never aliases) entries decoded by older logic.
+    transform_version = 1
+
+    def _cache_init(self) -> None:
+        """Resolve backend/store/retry policy (call early in ``on_init``).
+
+        ``cache`` semantics: a store instance uses exactly that store;
+        ``None`` defers to the ``DDL_TPU_CACHE`` env gate; ``False``
+        forces the cache OFF regardless of the environment (the bench's
+        uncached control arm and A/B baselines need a value that cannot
+        be flipped by an exported gate).
+        """
+        from ddl_tpu import cache as cache_mod
+
+        self._backend = getattr(self, "backend", None) or cache_mod.LocalBackend()
+        explicit = getattr(self, "cache", None)
+        if explicit is False:
+            self._cache = None
+        elif explicit is not None:
+            self._cache = explicit
+        elif cache_mod.cache_enabled():
+            self._cache = cache_mod.default_store()
+        else:
+            self._cache = None
+        self._retry = cache_mod.retry_settings_from_env()
+        if not hasattr(self, "_warmer"):
+            self._warmer = None
+
+    def _reader_params(self) -> str:
+        """Constructor params that change decoded bytes (key material)."""
+        return ""
+
+    def _shard_key(self, path: str):
+        from ddl_tpu import cache as cache_mod
+
+        return cache_mod.CacheKey(
+            source=self._backend.fingerprint(path),
+            shard=path,
+            reader=f"{type(self).__qualname__}({self._reader_params()})",
+            transform=str(self.transform_version),
+        )
+
+    def _open_shard(self, path: str, should_abort=None) -> BinaryIO:
+        """Backend open with the one bounded retry/backoff policy."""
+        from ddl_tpu import cache as cache_mod
+
+        m = self._cache.metrics if self._cache is not None else None
+        return cache_mod.open_with_retry(
+            self._backend, path, metrics=m, should_abort=should_abort,
+            **self._retry,
+        )
+
+    def _cached_shard(
+        self, path: str, decode: Callable[[str, BinaryIO], np.ndarray]
+    ) -> np.ndarray:
+        """Whole-shard get-or-decode (``decode(path, open_file)``).
+
+        On a miss — including a corrupt disk entry the store just
+        quarantined — the shard is refetched from source and
+        re-inserted, so corruption degrades to one extra fetch, never to
+        wrong data.  The returned array is read-only when it came from
+        the cache: treat it as shared.
+        """
+        if self._cache is None:
+            with self._open_shard(path) as f:
+                return decode(path, f)
+        key = self._shard_key(path)
+        arr = self._cache.get(key)
+        if arr is None:
+            with self._open_shard(path) as f:
+                arr = self._cache.put(key, decode(path, f))
+        return arr
+
+    def _start_warmer(
+        self,
+        paths: Sequence[str],
+        decode: Callable[[str, BinaryIO], np.ndarray],
+    ) -> None:
+        """Kick off epoch-order prefetch of ``paths`` (idempotent; no-op
+        without a cache or with warming disabled)."""
+        from ddl_tpu import cache as cache_mod
+
+        if (
+            self._cache is None
+            or self._warmer is not None
+            or not cache_mod.warm_enabled(getattr(self, "warm", None))
+        ):
+            return
+
+        def job(path):
+            def load(should_abort):
+                with self._open_shard(path, should_abort=should_abort) as f:
+                    return decode(path, f)
+
+            # Key as a thunk: fingerprinting is a per-shard backend
+            # round trip, paid on the WARMER thread, not serially here
+            # on the producer's init path.
+            return (lambda p=path: self._shard_key(p), load)
+
+        self._warmer = cache_mod.CacheWarmer(
+            self._cache,
+            [job(p) for p in paths],
+            name=f"ddl-cache-warmer-{type(self).__name__}",
+        )
+
+    def on_push_end(self, **kw: Any) -> None:
+        """Producer teardown hook: stop the warmer (bounded join)."""
+        w = getattr(self, "_warmer", None)
+        if w is not None:
+            w.close()
+            self._warmer = None
 
 
 class ArrayProducer(ProducerFunctionSkeleton):
@@ -91,20 +238,30 @@ class ArrayProducer(ProducerFunctionSkeleton):
         self._fill(my_ary)
 
 
-class FileShardProducer(ProducerFunctionSkeleton):
+class FileShardProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
     """Stream ``.npy`` shard files matching a glob, shard-per-worker.
 
     The layout of WebDataset/ImageNet-style shard collections: many
     same-shaped record files; each worker round-robins its own subset,
     loading one shard per window refill (IO overlaps training via the
-    ring's double buffering).
+    ring's double buffering).  Shard reads go through the storage
+    backend + shard cache (:class:`_ShardCacheMixin`): with
+    ``DDL_TPU_CACHE=1`` (or an explicit ``cache=`` store), every epoch
+    after the first serves decoded shards from the warm tier.  The
+    per-refill reshuffle draws a permutation from the worker's seeded
+    RNG, so the served stream is identical whether a shard came from
+    source or from cache.
     """
 
     def __init__(self, pattern: str, splits: Optional[Sequence[int]] = None,
-                 seed: int = 0):
+                 seed: int = 0, backend: Any = None, cache: Any = None,
+                 warm: Optional[bool] = None):
         self.pattern = pattern
         self.splits = tuple(splits) if splits else None
         self.seed = seed
+        self.backend = backend
+        self.cache = cache
+        self.warm = warm
 
     def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
                 n_instances=1, **kw) -> DataProducerOnInitReturn:
@@ -114,9 +271,11 @@ class FileShardProducer(ProducerFunctionSkeleton):
         )
         self._cursor = 0
         self._rng = np.random.default_rng([self.seed, producer_idx])
-        first = np.load(self._paths[0])
+        self._cache_init()
+        first = self._cached_shard(self._paths[0], self._decode)
         self._shape = first.shape
         self._dtype = first.dtype
+        self._start_warmer(self._paths, self._decode)
         return DataProducerOnInitReturn(
             nData=first.shape[0],
             nValues=int(np.prod(first.shape[1:])),
@@ -125,12 +284,19 @@ class FileShardProducer(ProducerFunctionSkeleton):
             dtype=first.dtype,
         )
 
+    @staticmethod
+    def _decode(path: str, f: BinaryIO) -> np.ndarray:
+        return np.load(f)
+
     def _load_next(self, my_ary: np.ndarray) -> None:
         path = self._paths[self._cursor % len(self._paths)]
         self._cursor += 1
-        arr = np.load(path).reshape(my_ary.shape)
-        self._rng.shuffle(arr)
-        np.copyto(my_ary, arr)
+        # Cached arrays are shared and read-only, so the reshuffle is a
+        # permutation GATHER into the window, never an in-place shuffle
+        # of the source (which would corrupt every later epoch's hit).
+        arr = self._cached_shard(path, self._decode).reshape(my_ary.shape)
+        perm = self._rng.permutation(len(arr))
+        np.copyto(my_ary, arr[perm])
 
     def post_init(self, my_ary, **kw):
         self._load_next(my_ary)
@@ -237,7 +403,7 @@ class PackedTokenProducer(TokenStreamProducer):
         my_ary[:, self.seq_len :] = seg
 
 
-class WebDatasetProducer(ProducerFunctionSkeleton):
+class WebDatasetProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
     """WebDataset-style tar-shard image reader (BASELINE configs[1-2]).
 
     Each shard is a ``.tar`` whose members pair by basename, the standard
@@ -249,15 +415,29 @@ class WebDatasetProducer(ProducerFunctionSkeleton):
     strided rule and read as tar *streams*, sample by sample (only the
     current sample's files are in memory — a multi-hundred-MB ImageNet
     shard is never materialised whole), cycling shards forever.
+
+    With the shard cache enabled the DECODED rows of each shard land in
+    the warm tier as one ``(n_samples, H*W*3+1)`` float32 array — image
+    decode is this reader's dominant cost, so epoch ≥ 2 skips the tar
+    read *and* every PIL decode.  The cold path still streams (rows are
+    served as they decode; the shard array is only assembled for the
+    cache insert), and serves byte-identical rows either way.
     """
 
     _IMG_EXT = (".jpg", ".jpeg", ".png")
 
     def __init__(self, pattern: str, image_size: int = 32,
-                 window_rows: int = 64):
+                 window_rows: int = 64, backend: Any = None,
+                 cache: Any = None, warm: Optional[bool] = None):
         self.pattern = pattern
         self.image_size = image_size
         self.window_rows = window_rows
+        self.backend = backend
+        self.cache = cache
+        self.warm = warm
+
+    def _reader_params(self) -> str:
+        return f"image_size={self.image_size}"
 
     def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
                 n_instances=1, **kw) -> DataProducerOnInitReturn:
@@ -271,19 +451,21 @@ class WebDatasetProducer(ProducerFunctionSkeleton):
             self.pattern, producer_idx, n_producers, instance_idx,
             n_instances,
         )
-        self._iter = self._stream_samples()
-        n_px = self.image_size * self.image_size * 3
+        self._cache_init()
+        self._n_px = self.image_size * self.image_size * 3
+        self._iter = self._stream_rows()
+        self._start_warmer(self._shards, self._decode_shard)
         return DataProducerOnInitReturn(
             nData=self.window_rows,
-            nValues=n_px + 1,
-            shape=(self.window_rows, n_px + 1),
-            splits=(n_px, 1),
+            nValues=self._n_px + 1,
+            shape=(self.window_rows, self._n_px + 1),
+            splits=(self._n_px, 1),
         )
 
     # -- tar streaming -----------------------------------------------------
 
-    def _stream_samples(self):
-        """Yield (image_bytes, label), streaming tars and cycling forever.
+    def _stream_pairs(self, f):
+        """Yield (image_bytes, label) from ONE open tar stream.
 
         WebDataset convention keeps a sample's files adjacent, but pairing
         is done by key so ordering within a key doesn't matter; ``pending``
@@ -291,40 +473,90 @@ class WebDatasetProducer(ProducerFunctionSkeleton):
         """
         import tarfile
 
+        with tarfile.open(fileobj=f, mode="r|*") as tf:  # streaming read
+            pending: dict = {}
+            done: set = set()  # keys already yielded this shard
+            for m in tf:
+                if not m.isfile():
+                    continue
+                stem, dot, ext = m.name.rpartition(".")
+                ext = dot + ext.lower()
+                # Only the pairing members buffer; .json/.txt/...
+                # sidecars would otherwise leak (and once a key has
+                # yielded, trailing members for it are dropped too).
+                if ext not in self._IMG_EXT and ext != ".cls":
+                    continue
+                if stem in done:
+                    continue
+                d = pending.setdefault(stem, {})
+                d[ext] = tf.extractfile(m).read()
+                img = next(
+                    (d[e] for e in self._IMG_EXT if e in d), None
+                )
+                if img is not None and ".cls" in d:
+                    del pending[stem]
+                    done.add(stem)
+                    yield img, int(d[".cls"].decode().strip())
+
+    def _row(self, img_bytes: bytes, label: int) -> np.ndarray:
+        row = np.empty(self._n_px + 1, np.float32)
+        row[:-1] = self._decode(img_bytes)
+        row[-1] = float(label)
+        return row
+
+    def _decode_shard(self, path: str, f) -> np.ndarray:
+        """Whole-shard decode → (n_samples, n_px+1) rows (warmer path)."""
+        rows = [self._row(img, lab) for img, lab in self._stream_pairs(f)]
+        if not rows:
+            raise ValueError(f"shard {path} holds no (image, .cls) pairs")
+        return np.stack(rows)
+
+    def _stream_rows(self):
+        """Yield decoded window rows, cycling shards forever.
+
+        Warm shards come straight out of the cache (no tar open, no PIL
+        decode); cold shards stream row-by-row and are inserted whole at
+        shard end — an abandoned mid-shard stream caches nothing rather
+        than something partial.
+        """
         shard_i = 0
         while True:
             path = self._shards[shard_i % len(self._shards)]
             shard_i += 1
-            yielded = 0
-            with tarfile.open(path, mode="r|*") as tf:  # streaming read
-                pending: dict = {}
-                done: set = set()  # keys already yielded this shard
-                for m in tf:
-                    if not m.isfile():
-                        continue
-                    stem, dot, ext = m.name.rpartition(".")
-                    ext = dot + ext.lower()
-                    # Only the pairing members buffer; .json/.txt/...
-                    # sidecars would otherwise leak (and once a key has
-                    # yielded, trailing members for it are dropped too).
-                    if ext not in self._IMG_EXT and ext != ".cls":
-                        continue
-                    if stem in done:
-                        continue
-                    d = pending.setdefault(stem, {})
-                    d[ext] = tf.extractfile(m).read()
-                    img = next(
-                        (d[e] for e in self._IMG_EXT if e in d), None
+            cached = (
+                self._cache.get(self._shard_key(path))
+                if self._cache is not None else None
+            )
+            if cached is not None:
+                if len(cached) == 0:
+                    raise ValueError(
+                        f"shard {path} holds no (image, .cls) pairs"
                     )
-                    if img is not None and ".cls" in d:
-                        del pending[stem]
-                        done.add(stem)
-                        yielded += 1
-                        yield img, int(d[".cls"].decode().strip())
-            if yielded == 0:
+                yield from cached
+                continue
+            collect = [] if self._cache is not None else None
+            collect_bytes = 0
+            n = 0
+            with self._open_shard(path) as f:
+                for img, label in self._stream_pairs(f):
+                    row = self._row(img, label)
+                    n += 1
+                    if collect is not None:
+                        collect.append(row)
+                        collect_bytes += row.nbytes
+                        if collect_bytes > self._cache.ram_budget_bytes:
+                            # Decoded shard exceeds what either tier
+                            # would keep: stop buffering and preserve
+                            # this reader's never-materialise-the-shard
+                            # memory bound — the stream itself goes on.
+                            collect = None
+                    yield row
+            if n == 0:
                 raise ValueError(
                     f"shard {path} holds no (image, .cls) pairs"
                 )
+            if collect is not None:
+                self._cache.put(self._shard_key(path), np.stack(collect))
 
     def _decode(self, img_bytes: bytes) -> np.ndarray:
         import io
@@ -338,9 +570,7 @@ class WebDatasetProducer(ProducerFunctionSkeleton):
 
     def _fill(self, my_ary: np.ndarray) -> None:
         for row in range(self.window_rows):
-            img, label = next(self._iter)
-            my_ary[row, :-1] = self._decode(img)
-            my_ary[row, -1] = float(label)
+            my_ary[row] = next(self._iter)
 
     def post_init(self, my_ary, **kw):
         self._fill(my_ary)
@@ -446,7 +676,11 @@ def tfrecord_crc_enabled(override: Optional[bool] = None) -> bool:
     return env_flag("DDL_TPU_TFRECORD_CRC", override)
 
 
-def iter_tfrecords(path: str, verify_crc: Optional[bool] = None):
+def iter_tfrecords(
+    path: str,
+    verify_crc: Optional[bool] = None,
+    fileobj: Optional[BinaryIO] = None,
+):
     """Yield raw record payloads from a TFRecord file.
 
     Framing (TFRecord spec): u64le length, u32 masked length-crc,
@@ -458,43 +692,57 @@ def iter_tfrecords(path: str, verify_crc: Optional[bool] = None):
     (anywhere short of its full ``length + trailer`` framing) is treated
     as end-of-stream in BOTH modes — the validation knob must never
     change which records a file serves, only whether they are checked.
+
+    ``fileobj`` reads an already-open stream instead of opening ``path``
+    (the storage-backend seam: producers pass a backend-opened handle;
+    ``path`` then only labels error messages).  The caller owns and
+    closes a passed ``fileobj``.
     """
+    if fileobj is not None:
+        yield from _iter_tfrecord_stream(path, fileobj, verify_crc)
+        return
+    with open(path, "rb") as f:
+        yield from _iter_tfrecord_stream(path, f, verify_crc)
+
+
+def _iter_tfrecord_stream(
+    path: str, f: BinaryIO, verify_crc: Optional[bool]
+):
     import struct
 
     verify = tfrecord_crc_enabled(verify_crc)
-    with open(path, "rb") as f:
-        offset = 0
-        while True:
-            head = f.read(12)
-            if len(head) < 12:
-                return
-            (length,) = struct.unpack("<Q", head[:8])
-            if verify:
-                (got_len_crc,) = struct.unpack("<I", head[8:12])
-                want_len_crc = masked_crc32c(head[:8])
-                if got_len_crc != want_len_crc:
-                    raise IntegrityError(
-                        f"{path}: corrupt TFRecord length-crc at offset "
-                        f"{offset} (0x{got_len_crc:08x} != "
-                        f"0x{want_len_crc:08x})"
-                    )
-            payload = f.read(length)
-            if len(payload) < length:
-                return
-            tail = f.read(4)
-            if len(tail) < 4:
-                return  # truncated trailer: end-of-stream (both modes)
-            if verify:
-                (got_crc,) = struct.unpack("<I", tail)
-                want_crc = masked_crc32c(payload)
-                if got_crc != want_crc:
-                    raise IntegrityError(
-                        f"{path}: corrupt TFRecord payload at offset "
-                        f"{offset} ({length} bytes; crc 0x{got_crc:08x} "
-                        f"!= 0x{want_crc:08x})"
-                    )
-            offset += 12 + length + 4
-            yield payload
+    offset = 0
+    while True:
+        head = f.read(12)
+        if len(head) < 12:
+            return
+        (length,) = struct.unpack("<Q", head[:8])
+        if verify:
+            (got_len_crc,) = struct.unpack("<I", head[8:12])
+            want_len_crc = masked_crc32c(head[:8])
+            if got_len_crc != want_len_crc:
+                raise IntegrityError(
+                    f"{path}: corrupt TFRecord length-crc at offset "
+                    f"{offset} (0x{got_len_crc:08x} != "
+                    f"0x{want_len_crc:08x})"
+                )
+        payload = f.read(length)
+        if len(payload) < length:
+            return
+        tail = f.read(4)
+        if len(tail) < 4:
+            return  # truncated trailer: end-of-stream (both modes)
+        if verify:
+            (got_crc,) = struct.unpack("<I", tail)
+            want_crc = masked_crc32c(payload)
+            if got_crc != want_crc:
+                raise IntegrityError(
+                    f"{path}: corrupt TFRecord payload at offset "
+                    f"{offset} ({length} bytes; crc 0x{got_crc:08x} "
+                    f"!= 0x{want_crc:08x})"
+                )
+        offset += 12 + length + 4
+        yield payload
 
 
 def _read_varint(buf: bytes, pos: int):
@@ -568,7 +816,7 @@ def example_int64_feature(payload: bytes, key: str) -> Optional[np.ndarray]:
     return None
 
 
-class TFRecordTokenProducer(ProducerFunctionSkeleton):
+class TFRecordTokenProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
     """C4-style tokenized TFRecord stream (BASELINE configs[3]).
 
     Shard files matching ``pattern`` are assigned per worker; records
@@ -577,11 +825,18 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
     tf.Example whose int64-list feature supplies tokens; with
     ``feature_key=None`` record payloads are raw little-endian int32
     tokens.  Token streams concatenate and cut into ``seq_len`` rows.
+
+    With the shard cache enabled, a shard's parsed tokens land in the
+    warm tier as ONE concatenated int32 array — epoch ≥ 2 skips the
+    framing walk, both CRC passes, and the protobuf micro-decode.  The
+    stream is byte-identical either way (the consumer concatenates
+    chunks regardless of their cut points).
     """
 
     def __init__(self, pattern: str, seq_len: int, window_rows: int,
                  feature_key: Optional[str] = "input_ids",
-                 verify_crc: Optional[bool] = None):
+                 verify_crc: Optional[bool] = None, backend: Any = None,
+                 cache: Any = None, warm: Optional[bool] = None):
         self.pattern = pattern
         self.seq_len = seq_len
         self.window_rows = window_rows
@@ -589,6 +844,12 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
         #: None defers to the ``DDL_TPU_TFRECORD_CRC`` gate (default on);
         #: False is the trusted-local-data opt-out.
         self.verify_crc = verify_crc
+        self.backend = backend
+        self.cache = cache
+        self.warm = warm
+
+    def _reader_params(self) -> str:
+        return f"feature_key={self.feature_key}"
 
     def on_init(self, producer_idx=0, n_producers=1, instance_idx=0,
                 n_instances=1, **kw) -> DataProducerOnInitReturn:
@@ -596,8 +857,10 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
             self.pattern, producer_idx, n_producers, instance_idx,
             n_instances,
         )
+        self._cache_init()
         self._records = self._stream_records()
         self._buf = np.zeros((0,), np.int32)
+        self._start_warmer(self._shards, self._decode_shard)
         return DataProducerOnInitReturn(
             nData=self.window_rows,
             nValues=self.seq_len,
@@ -606,20 +869,70 @@ class TFRecordTokenProducer(ProducerFunctionSkeleton):
             dtype=np.int32,
         )
 
+    def _decode_shard(self, path: str, f) -> np.ndarray:
+        """Whole-shard parse → one concatenated token array (warmer path).
+
+        An all-empty shard caches as a zero-length array: warm epochs
+        then skip it without refetching, and the dry-shard accounting in
+        ``_stream_records`` still sees it contribute no tokens.
+        """
+        chunks = [
+            self._tokens_from(p)
+            for p in iter_tfrecords(
+                path, verify_crc=self.verify_crc, fileobj=f
+            )
+        ]
+        chunks = [c for c in chunks if len(c)]
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.int32)
+
     def _stream_records(self):
         """Yield token chunks record-by-record, cycling shards forever —
-        memory stays bounded by one record, not one shard, and the first
-        batch is served as soon as enough records have parsed."""
+        memory stays bounded by one record, not one shard (cold path;
+        the cache insert assembles the shard's tokens once at shard
+        end), and the first batch is served as soon as enough records
+        have parsed.  Warm shards yield their whole token array as one
+        chunk — same concatenated stream, zero parse work."""
         shard_i = 0
         while True:
             path = self._shards[shard_i % len(self._shards)]
             shard_i += 1
             grew = False
-            for payload in iter_tfrecords(path, verify_crc=self.verify_crc):
-                toks = self._tokens_from(payload)
-                if len(toks):
+            cached = (
+                self._cache.get(self._shard_key(path))
+                if self._cache is not None else None
+            )
+            if cached is not None:
+                if len(cached):
                     grew = True
-                    yield toks
+                    yield cached
+            else:
+                collect = [] if self._cache is not None else None
+                collect_bytes = 0
+                with self._open_shard(path) as f:
+                    for payload in iter_tfrecords(
+                        path, verify_crc=self.verify_crc, fileobj=f
+                    ):
+                        toks = self._tokens_from(payload)
+                        if len(toks):
+                            grew = True
+                            if collect is not None:
+                                collect.append(toks)
+                                collect_bytes += toks.nbytes
+                                if (
+                                    collect_bytes
+                                    > self._cache.ram_budget_bytes
+                                ):
+                                    # Shard too big for either tier:
+                                    # keep streaming record-bounded,
+                                    # don't buffer the uncacheable.
+                                    collect = None
+                            yield toks
+                if collect is not None:
+                    self._cache.put(
+                        self._shard_key(path),
+                        np.concatenate(collect)
+                        if collect else np.zeros(0, np.int32),
+                    )
             if not grew:
                 # Track consecutive dry shards (records with zero tokens
                 # or none at all) so an all-empty shard set raises instead

@@ -118,7 +118,10 @@ class StagingPool:
             else max_per_key
         )
         self._lock = threading.Lock()
-        self._free: Dict[Tuple[Tuple[int, ...], Any], List[np.ndarray]] = {}
+        # Free-lists hold at most max_per_key buffers per geometry key
+        # (release() drops beyond the cap), and a run's batch geometries
+        # are a small closed set — bounded by construction.
+        self._free: Dict[Tuple[Tuple[int, ...], Any], List[np.ndarray]] = {}  # ddl-lint: disable=DDL013
         #: FIFO of (device value to poll, buffer, dispatch timestamp).
         self._inflight: Deque[Tuple[Any, np.ndarray, float]] = (
             collections.deque()

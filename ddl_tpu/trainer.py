@@ -111,7 +111,11 @@ class Trainer:
         )
         # window_stream multistep programs, keyed by steps-per-window, so
         # repeated fit() calls on one Trainer reuse the compiled scan.
+        # LRU-bounded (DDL013): a pathological producer mix emitting a
+        # new window depth per rotation would otherwise pin every
+        # compiled program it ever built; evicted depths just recompile.
         self._multistep_cache: dict = {}
+        self._multistep_cache_cap = 8
 
     # -- checkpoint plumbing ----------------------------------------------
 
@@ -288,14 +292,20 @@ class Trainer:
             # producers, windows of different depths arrive as the
             # rotation advances, each needing its own scan length
             # (compiled once per distinct depth, cached).
-            fn = self._multistep_cache.get(n_steps)
+            fn = self._multistep_cache.pop(n_steps, None)
             if fn is None:
                 _, fn = make_multistep(
                     self._loss_fn, self._optimizer, self.mesh,
                     self._param_specs, batch_spec=self._batch_spec,
                     n_steps=n_steps, accum_steps=self._accum_steps,
                 )
-                self._multistep_cache[n_steps] = fn
+            # Re-insert at the MRU end (dict preserves insertion order);
+            # trim the LRU end past the cap.
+            self._multistep_cache[n_steps] = fn
+            while len(self._multistep_cache) > self._multistep_cache_cap:
+                self._multistep_cache.pop(
+                    next(iter(self._multistep_cache))
+                )
             return fn
 
         pending = None

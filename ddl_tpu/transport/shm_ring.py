@@ -399,7 +399,9 @@ class PyShmRing(WindowRing):
         self._owner = create
         self.nslots = int(self._u64[3])
         self.slot_bytes = int(self._u64[4])
-        self._stall = {"producer_stall_s": 0.0, "consumer_stall_s": 0.0}
+        # Fixed two-key accumulator: _wait only ever += into the keys
+        # initialised here.
+        self._stall = {"producer_stall_s": 0.0, "consumer_stall_s": 0.0}  # ddl-lint: disable=DDL013
 
     create = classmethod(lambda cls, name, nslots, slot_bytes: cls(
         name, nslots, slot_bytes, create=True))

@@ -63,8 +63,9 @@ class Watchdog:
         self.respawns: List[int] = []  # producer_idx per respawn event
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._last_progress: Dict[int, tuple] = {}
-        self._last_change: Dict[int, float] = {}
+        # Keyed by producer ring index: bounded by n_producers.
+        self._last_progress: Dict[int, tuple] = {}  # ddl-lint: disable=DDL013
+        self._last_change: Dict[int, float] = {}  # ddl-lint: disable=DDL013
         self.failures: List[str] = []
         self._dead_idx: Optional[int] = None  # set by check_once
         # ring index -> committed count at respawn time.  While present,

@@ -1,0 +1,741 @@
+"""The shard cache (ddl_tpu/cache): tiers, keys, faults, warmer, resume.
+
+ISSUE 4's acceptance matrix:
+
+- cold-vs-warm streams are BYTE-IDENTICAL for every cacheable reader
+  (FileShard / WebDataset / TFRecord) — the cache may change speed,
+  never data;
+- the RAM LRU respects a tight byte budget (evictions, bounded
+  residency, LRU order);
+- a corrupt disk entry is quarantined and the shard refetched from
+  source (via the deterministic fault matrix — ``cache.disk_read``
+  corruption) with the stream still intact;
+- transient backend failures heal under the bounded retry/backoff;
+  persistent failure escalates to ``IntegrityError``;
+- the background warmer shuts down cleanly mid-prefetch (bounded join,
+  no leaked threads);
+- ``LoaderCheckpoint`` carries the cache manifest and a resumed store
+  warm-starts from the disk tier.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from datagen import encode_example_int64, write_image_shard, write_tfrecord
+from ddl_tpu import faults
+from ddl_tpu.cache import (
+    KEY_SCHEMA_VERSION,
+    CacheKey,
+    CacheStore,
+    CacheWarmer,
+    LocalBackend,
+    ThrottledBackend,
+    open_with_retry,
+)
+from ddl_tpu.exceptions import BackendFetchError, IntegrityError, ShutdownRequested
+from ddl_tpu.faults import FaultKind, FaultPlan, FaultSpec
+from ddl_tpu.observability import Metrics
+from ddl_tpu.readers import (
+    FileShardProducer,
+    TFRecordTokenProducer,
+    WebDatasetProducer,
+)
+
+
+def _store(tmp_path=None, budget=64 << 20, **kw):
+    m = Metrics()
+    spill = str(tmp_path / "spill") if tmp_path is not None else None
+    return CacheStore(
+        ram_budget_bytes=budget, spill_dir=spill, metrics=m, **kw
+    ), m
+
+
+def _npy_shards(tmp_path, n=4, rows=16, cols=8, seed=0):
+    d = tmp_path / "shards"
+    d.mkdir(exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        np.save(d / f"s{i}.npy",
+                rng.standard_normal((rows, cols)).astype(np.float32))
+    return str(d / "s*.npy")
+
+
+def _drive(producer, n_fills):
+    """on_init + post_init + n_fills-1 refills; stacked copies served."""
+    ret = producer.on_init(producer_idx=1)
+    ary = np.zeros(ret.shape, ret.dtype)
+    out = []
+    producer.post_init(my_ary=ary)
+    out.append(ary.copy())
+    for _ in range(n_fills - 1):
+        producer.execute_function(my_ary=ary)
+        out.append(ary.copy())
+    return np.stack(out)
+
+
+class TestCacheKey:
+    def test_any_field_change_moves_the_digest(self):
+        base = CacheKey("src:1:2", "a.npy", "R(p=1)", "1")
+        assert base.digest == CacheKey("src:1:2", "a.npy", "R(p=1)", "1").digest
+        for variant in (
+            CacheKey("src:1:3", "a.npy", "R(p=1)", "1"),   # source rewritten
+            CacheKey("src:1:2", "b.npy", "R(p=1)", "1"),   # different shard
+            CacheKey("src:1:2", "a.npy", "R(p=2)", "1"),   # reader params
+            CacheKey("src:1:2", "a.npy", "R(p=1)", "2"),   # transform bump
+        ):
+            assert variant.digest != base.digest
+
+    def test_reader_params_feed_the_key(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=1)
+        path = pattern.replace("s*", "s0")
+        a = WebDatasetProducer("x", image_size=8, cache=None)
+        b = WebDatasetProducer("x", image_size=16, cache=None)
+        for p in (a, b):
+            p._cache_init()
+        assert a._shard_key(path).digest != b._shard_key(path).digest
+
+
+class TestRamTier:
+    def test_lru_eviction_under_byte_budget(self, tmp_path):
+        entry = np.zeros(1000, np.uint8)  # 1000 B each
+        store, m = _store(budget=3500)
+        keys = [CacheKey("s", f"k{i}", "R()") for i in range(5)]
+        for k in keys:
+            store.put(k, entry.copy())
+        assert store.resident_bytes <= 3500
+        assert m.counter("cache.evictions") == 2
+        # LRU order: oldest two evicted, newest three resident.
+        assert store.get(keys[0]) is None and store.get(keys[1]) is None
+        assert store.get(keys[4]) is not None
+        assert m.gauge("cache.resident_bytes.max") <= 3500
+
+    def test_get_refreshes_recency(self):
+        store, m = _store(budget=2500)
+        ka, kb, kc = (CacheKey("s", k, "R()") for k in "abc")
+        store.put(ka, np.zeros(1000, np.uint8))
+        store.put(kb, np.zeros(1000, np.uint8))
+        assert store.get(ka) is not None      # a becomes MRU
+        store.put(kc, np.zeros(1000, np.uint8))  # evicts b, not a
+        assert store.get(ka) is not None
+        assert store.get(kb) is None
+
+    def test_entries_are_read_only(self):
+        store, _ = _store()
+        arr = store.put(CacheKey("s", "a", "R()"), np.arange(8))
+        with pytest.raises(ValueError):
+            arr[0] = 99
+
+
+class TestDiskTier:
+    def test_write_through_spill_and_promote(self, tmp_path):
+        store, m = _store(tmp_path)
+        k = CacheKey("s", "a", "R()")
+        orig = np.arange(256, dtype=np.int64).reshape(16, 16)
+        store.put(k, orig)
+        assert m.counter("cache.spills") == 1
+        store.clear()  # drop RAM: next get must come from disk
+        got = store.get(k)
+        assert got is not None and np.array_equal(got, orig)
+        assert got.dtype == orig.dtype and got.shape == orig.shape
+        assert m.counter("cache.spill_hits") == 1
+        assert not got.flags.writeable
+
+    def test_corrupt_disk_entry_is_quarantined(self, tmp_path):
+        store, m = _store(tmp_path)
+        k = CacheKey("s", "a", "R()")
+        store.put(k, np.arange(1000, dtype=np.float64))
+        store.clear()
+        p = store._spill_path(k.digest)
+        raw = bytearray(open(p, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        assert store.get(k) is None  # miss, not wrong data
+        assert m.counter("cache.quarantined") == 1
+        assert not os.path.exists(p)
+        assert os.path.exists(p[:-5] + ".quarantined")
+        # And the caller's refetch re-populates cleanly.
+        store.put(k, np.arange(1000, dtype=np.float64))
+        store.clear()
+        assert store.get(k) is not None
+
+    def test_entry_cannot_alias_a_foreign_key(self, tmp_path):
+        """A spill file copied onto another key's name fails the
+        digest-derived seq check even though its payload CRC is intact."""
+        store, m = _store(tmp_path)
+        ka, kb = CacheKey("s", "a", "R()"), CacheKey("s", "b", "R()")
+        store.put(ka, np.arange(64))
+        import shutil
+
+        shutil.copy(store._spill_path(ka.digest), store._spill_path(kb.digest))
+        store.clear()
+        assert store.get(kb) is None
+        assert m.counter("cache.quarantined") == 1
+
+    def test_spill_budget_trims_oldest(self, tmp_path):
+        store, m = _store(tmp_path, spill_budget_bytes=4000)
+        for i in range(6):  # ~1KB+meta each
+            store.put(CacheKey("s", f"k{i}", "R()"), np.zeros(1000, np.uint8))
+            time.sleep(0.01)  # distinct mtimes for oldest-first order
+        assert m.counter("cache.spill_evictions") > 0
+        files = [
+            f for f in os.listdir(store.spill_dir) if f.endswith(".ddlc")
+        ]
+        assert 0 < len(files) < 6
+
+    def test_oversized_entry_skips_spill_tier(self, tmp_path):
+        """An entry bigger than the whole disk budget is not written —
+        writing it would only make the trim evict every valid entry
+        plus the new file itself, every miss."""
+        store, m = _store(tmp_path, spill_budget_bytes=2000)
+        small = CacheKey("s", "small", "R()")
+        store.put(small, np.zeros(500, np.uint8))
+        big = CacheKey("s", "big", "R()")
+        store.put(big, np.zeros(5000, np.uint8))
+        assert os.path.exists(store._spill_path(small.digest))
+        assert not os.path.exists(store._spill_path(big.digest))
+        assert m.counter("cache.spill_evictions") == 0
+
+    def test_quarantine_retention_is_bounded(self, tmp_path):
+        """Recurring corruption must not grow the spill dir forever:
+        only the newest QUARANTINE_KEEP post-mortem files survive."""
+        from ddl_tpu.cache.store import QUARANTINE_KEEP
+
+        store, m = _store(tmp_path)
+        for i in range(QUARANTINE_KEEP + 3):
+            k = CacheKey("s", f"bad{i}", "R()")
+            store.put(k, np.arange(64))
+            store.clear()
+            p = store._spill_path(k.digest)
+            raw = bytearray(open(p, "rb").read())
+            raw[len(raw) // 2] ^= 0xFF  # payload byte (the blob's last
+            # 8 bytes are the trailer's RESERVED region — unverified)
+            open(p, "wb").write(bytes(raw))
+            time.sleep(0.01)  # distinct mtimes for newest-first keep
+            assert store.get(k) is None
+        q = [f for f in os.listdir(store.spill_dir)
+             if f.endswith(".quarantined")]
+        assert len(q) == QUARANTINE_KEEP
+        assert m.counter("cache.quarantined") == QUARANTINE_KEEP + 3
+
+    def test_attach_spill_dir_late_binds_a_tier(self, tmp_path):
+        """Manifest adoption on an already-built RAM-only store (the
+        THREAD-mode resume shape: apply() runs after the store exists)."""
+        donor, _ = _store(tmp_path)
+        k = CacheKey("s", "a", "R()")
+        donor.put(k, np.arange(128))
+        ram_only = CacheStore(ram_budget_bytes=1 << 20, metrics=Metrics())
+        assert ram_only.get(k) is None
+        assert ram_only.attach_spill_dir(str(tmp_path / "spill"))
+        assert ram_only.get(k) is not None  # served from the adopted tier
+        # Idempotent for the same dir; refused for a different one.
+        assert ram_only.attach_spill_dir(str(tmp_path / "spill"))
+        other = tmp_path / "other"
+        other.mkdir()
+        assert not ram_only.attach_spill_dir(str(other))
+
+    def test_warm_start_adopts_existing_spill_dir(self, tmp_path):
+        store, _ = _store(tmp_path)
+        k = CacheKey("s", "a", "R()")
+        store.put(k, np.arange(32))
+        # A "new process": fresh store over the same dir, RAM cold.
+        store2 = CacheStore(
+            ram_budget_bytes=1 << 20, spill_dir=str(tmp_path / "spill"),
+            metrics=Metrics(),
+        )
+        assert store2.get(k) is not None
+        assert store2._spill_bytes > 0  # adopted accounting
+
+
+class TestBackends:
+    def test_throttled_failure_schedule_is_deterministic(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=1)
+        path = pattern.replace("s*", "s0")
+        be = ThrottledBackend(fail_every=2)
+        be.open(path).close()                      # open 1 ok
+        with pytest.raises(BackendFetchError):
+            be.open(path)                          # open 2 fails
+        be.open(path).close()                      # open 3 ok
+        assert be.opens == 3
+
+    def test_throttled_backend_pickles(self):
+        import pickle
+
+        be = ThrottledBackend(latency_s=0.5, fail_every=3)
+        be2 = pickle.loads(pickle.dumps(be))
+        assert (be2.latency_s, be2.fail_every) == (0.5, 3)
+        assert be2.opens == 0
+
+    def test_retry_heals_transient_failures(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=1)
+        path = pattern.replace("s*", "s0")
+        m = Metrics()
+        # fail_every=2 with retries: attempt 2 fails once, attempt 3 ok.
+        be = ThrottledBackend(fail_every=2)
+        be.open(path).close()
+        with open_with_retry(be, path, retries=3, backoff_s=0.001, metrics=m) as f:
+            assert f.read(1)
+        assert m.counter("cache.backend_retries") == 1
+
+    def test_persistent_failure_is_integrity_error(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=1)
+        path = pattern.replace("s*", "s0")
+        m = Metrics()
+        be = ThrottledBackend(fail_every=1)  # every open fails
+        with pytest.raises(IntegrityError):
+            open_with_retry(be, path, retries=2, backoff_s=0.001, metrics=m)
+        assert m.counter("cache.backend_failures") == 1
+        assert m.counter("cache.backend_retries") == 3  # initial + 2 retries
+
+    def test_retry_backoff_observes_abort(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=1)
+        path = pattern.replace("s*", "s0")
+        be = ThrottledBackend(fail_every=1)
+        t0 = time.monotonic()
+        with pytest.raises(ShutdownRequested):
+            open_with_retry(
+                be, path, retries=50, backoff_s=10.0,
+                should_abort=lambda: time.monotonic() - t0 > 0.05,
+            )
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestColdWarmByteIdentity:
+    """The acceptance bar: cached and uncached runs serve the same bytes,
+    and the warm epoch never touches the backend."""
+
+    def test_file_shard_producer(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=4)
+        n_fills = 8  # two epochs over this worker's 4 shards
+        plain = _drive(
+            FileShardProducer(pattern, seed=7, cache=False, warm=False), n_fills
+        )
+        store, m = _store()
+        be = ThrottledBackend()
+        cached = _drive(
+            FileShardProducer(pattern, seed=7, cache=store, backend=be,
+                              warm=False),
+            n_fills,
+        )
+        assert np.array_equal(plain, cached)
+        # Epoch 2 (fills 5-8) all hit; the backend saw each shard once.
+        assert be.opens == 4
+        assert m.counter("cache.misses") == 4
+        assert m.counter("cache.hits") >= 4
+
+    def test_webdataset_producer(self, tmp_path):
+        for s in range(2):
+            write_image_shard(
+                str(tmp_path / f"shard-{s}.tar"),
+                [(f"s{s}k{i}", s * 10 + i) for i in range(6)],
+            )
+        pattern = str(tmp_path / "shard-*.tar")
+
+        def make(cache, backend=None):
+            return WebDatasetProducer(
+                pattern, image_size=8, window_rows=4, cache=cache,
+                backend=backend, warm=False,
+            )
+
+        n_fills = 6  # 24 rows = two cycles over 12 samples
+        plain = _drive(make(False), n_fills)
+        store, m = _store()
+        be = ThrottledBackend()
+        cached = _drive(make(store, be), n_fills)
+        assert np.array_equal(plain, cached)
+        assert be.opens == 2          # each tar fetched+decoded once
+        assert m.counter("cache.hits") >= 2
+
+    def test_tfrecord_producer(self, tmp_path):
+        rng = np.random.default_rng(0)
+        for s in range(2):
+            payloads = [
+                encode_example_int64(
+                    "input_ids", rng.integers(0, 1000, 50).tolist()
+                )
+                for _ in range(8)
+            ]
+            write_tfrecord(str(tmp_path / f"c4-{s}.tfrecord"), payloads)
+        pattern = str(tmp_path / "c4-*.tfrecord")
+
+        def make(cache, backend=None):
+            return TFRecordTokenProducer(
+                pattern, seq_len=16, window_rows=8, cache=cache,
+                backend=backend, warm=False,
+            )
+
+        n_fills = 12  # 1536 tokens ≈ two cycles over 800 tokens/epoch
+        plain = _drive(make(False), n_fills)
+        store, m = _store()
+        be = ThrottledBackend()
+        cached = _drive(make(store, be), n_fills)
+        assert np.array_equal(plain, cached)
+        assert be.opens == 2          # warm cycles skip framing + parse
+        assert m.counter("cache.hits") >= 2
+
+
+class TestFaultMatrix:
+    """Deterministic cache faults (docs/ROBUSTNESS.md ladder, extended
+    by docs/CACHING.md): corruption → quarantine + refetch;
+    backend flakiness → bounded retry; persistence → IntegrityError."""
+
+    def test_corrupt_disk_entry_quarantines_and_refetches(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=3)
+        store, m = _store(tmp_path)
+        baseline = _drive(
+            FileShardProducer(pattern, seed=3, cache=store, warm=False), 3
+        )
+        store.clear()  # force the next reads through the DISK tier
+        plan = FaultPlan([
+            FaultSpec("cache.disk_read", FaultKind.CACHE_CORRUPTION,
+                      at=1, count=1, param=16),
+        ])
+        with faults.armed(plan):
+            replay = _drive(
+                FileShardProducer(pattern, seed=3, cache=store, warm=False), 3
+            )
+        assert plan.fired, "corruption fault never fired"
+        assert m.counter("cache.quarantined") == 1
+        # The corrupted entry fell back to source: same bytes served.
+        assert np.array_equal(baseline, replay)
+
+    def test_transient_backend_fault_heals_in_reader(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=2)
+        store, m = _store()
+        plan = FaultPlan([
+            FaultSpec("backend.fetch", FaultKind.BACKEND_FETCH_FAIL,
+                      at=2, count=2),
+        ])
+        os.environ["DDL_TPU_CACHE_BACKOFF_S"] = "0.001"
+        try:
+            with faults.armed(plan):
+                out = _drive(
+                    FileShardProducer(pattern, seed=1, cache=store,
+                                      warm=False), 2
+                )
+        finally:
+            os.environ.pop("DDL_TPU_CACHE_BACKOFF_S", None)
+        assert len(plan.fired) == 2
+        assert m.counter("cache.backend_retries") == 2
+        assert out.shape[0] == 2
+
+    def test_persistent_backend_fault_raises_integrity_error(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=2)
+        store, _ = _store()
+        plan = FaultPlan([
+            FaultSpec("backend.fetch", FaultKind.BACKEND_FETCH_FAIL,
+                      at=1, count=999),
+        ])
+        os.environ["DDL_TPU_CACHE_BACKOFF_S"] = "0.001"
+        try:
+            with faults.armed(plan):
+                with pytest.raises(IntegrityError):
+                    FileShardProducer(
+                        pattern, cache=store, warm=False
+                    ).on_init(producer_idx=1)
+        finally:
+            os.environ.pop("DDL_TPU_CACHE_BACKOFF_S", None)
+
+
+class TestWarmer:
+    def test_warmer_prefetches_in_epoch_order(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=3)
+        store, m = _store()
+        p = FileShardProducer(pattern, cache=store, warm=True)
+        p.on_init(producer_idx=1)
+        assert p._warmer is not None
+        deadline = time.monotonic() + 10.0
+        while p._warmer.alive and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not p._warmer.alive
+        # All 3 shards resident (on_init decoded #0; warmer the rest).
+        assert m.counter("cache.warmed") == 2
+        be = ThrottledBackend()
+        p2 = FileShardProducer(pattern, cache=store, backend=be, warm=False)
+        _drive(p2, 3)
+        p.on_push_end()
+
+    def test_warmer_shutdown_mid_prefetch(self, tmp_path):
+        """close() mid-prefetch: bounded join, thread really exits, no
+        ShutdownRequested leak, no leaked threads."""
+        pattern = _npy_shards(tmp_path, n=6)
+        store, _ = _store()
+        before = set(threading.enumerate())
+        p = FileShardProducer(
+            pattern, cache=store,
+            backend=ThrottledBackend(latency_s=0.2), warm=True,
+        )
+        p.on_init(producer_idx=1)
+        w = p._warmer
+        assert w is not None and w.alive
+        t0 = time.monotonic()
+        p.on_push_end()  # the producer teardown hook closes the warmer
+        assert time.monotonic() - t0 < 10.0
+        assert not w.alive
+        assert p._warmer is None
+        leaked = set(threading.enumerate()) - before
+        assert not {t for t in leaked if "warmer" in t.name}, leaked
+
+    def test_warmer_respects_byte_budget(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=6, rows=64, cols=64)  # 16KB each
+        store, m = _store()
+        jobs_seen = []
+
+        def job(path):
+            def load(should_abort):
+                jobs_seen.append(path)
+                return np.zeros((64, 64), np.float32)
+
+            return (CacheKey("s", path, "R()"), load)
+
+        import glob
+
+        paths = sorted(glob.glob(pattern))
+        w = CacheWarmer(
+            store, [job(p) for p in paths], budget_bytes=40_000
+        )
+        deadline = time.monotonic() + 10.0
+        while w.alive and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert w.close()
+        assert len(jobs_seen) == 3  # 3 * 16KB crosses the 40KB budget
+        assert w.warmed_bytes >= 40_000
+
+
+class TestCheckpointManifest:
+    def test_capture_and_roundtrip(self, tmp_path):
+        from ddl_tpu.checkpoint import LoaderCheckpoint
+
+        store, _ = _store(tmp_path)
+
+        class _L:
+            _epoch, _target, _batches_in_window = 2, 1, 3
+
+        ck = LoaderCheckpoint.capture(_L(), cache=store)
+        assert ck.cache_spill_dir == store.spill_dir
+        assert ck.cache_key_schema == KEY_SCHEMA_VERSION
+        path = str(tmp_path / "ck" / "loader.json")
+        ck.save(path)
+        back = LoaderCheckpoint.load(path)
+        assert back == ck
+
+    def test_apply_adopts_manifest(self, tmp_path, monkeypatch):
+        from ddl_tpu import cache as cache_mod
+        from ddl_tpu.checkpoint import LoaderCheckpoint
+
+        monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+        cache_mod.reset_default_store()
+        spill = tmp_path / "spill"
+        spill.mkdir()
+        ck = LoaderCheckpoint(
+            cache_spill_dir=str(spill),
+            cache_key_schema=KEY_SCHEMA_VERSION,
+        )
+
+        class _L:
+            _epoch = _target = _batches_in_window = 0
+
+        ck.apply(_L())
+        assert os.environ.get("DDL_TPU_CACHE_SPILL_DIR") == str(spill)
+        # The next default store (env-gated) reads the adopted tier.
+        monkeypatch.setenv("DDL_TPU_CACHE", "1")
+        try:
+            assert cache_mod.default_store().spill_dir == str(spill)
+        finally:
+            cache_mod.reset_default_store()
+            monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+
+    def test_apply_refuses_schema_mismatch(self, tmp_path, monkeypatch):
+        from ddl_tpu import cache as cache_mod
+        from ddl_tpu.checkpoint import LoaderCheckpoint
+
+        monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+        cache_mod.reset_default_store()
+        spill = tmp_path / "spill"
+        spill.mkdir()
+        ck = LoaderCheckpoint(
+            cache_spill_dir=str(spill),
+            cache_key_schema=KEY_SCHEMA_VERSION + 1,
+        )
+
+        class _L:
+            _epoch = _target = _batches_in_window = 0
+
+        ck.apply(_L())
+        assert os.environ.get("DDL_TPU_CACHE_SPILL_DIR") is None
+
+    def test_apply_attaches_tier_to_live_store(self, tmp_path, monkeypatch):
+        """THREAD-mode resume: the default store is already built
+        (RAM-only) when apply() runs — the manifest attaches the disk
+        tier to it in place rather than being refused."""
+        from ddl_tpu import cache as cache_mod
+        from ddl_tpu.checkpoint import LoaderCheckpoint
+
+        monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+        cache_mod.reset_default_store()
+        try:
+            live = cache_mod.default_store()  # built RAM-only
+            assert live.spill_dir is None
+            donor, _ = _store(tmp_path)
+            k = CacheKey("s", "a", "R()")
+            donor.put(k, np.arange(32))
+            ck = LoaderCheckpoint(
+                cache_spill_dir=donor.spill_dir,
+                cache_key_schema=KEY_SCHEMA_VERSION,
+            )
+
+            class _L:
+                _epoch = _target = _batches_in_window = 0
+
+            ck.apply(_L())
+            assert live.spill_dir == donor.spill_dir
+            assert live.get(k) is not None
+        finally:
+            cache_mod.reset_default_store()
+            monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+
+    def test_adopt_cache_manifest_prespawn_helper(self, tmp_path, monkeypatch):
+        """The PROCESS-mode pre-spawn path: adopt straight from the
+        checkpoint file, before any store (or worker) exists."""
+        from ddl_tpu import cache as cache_mod
+        from ddl_tpu.checkpoint import LoaderCheckpoint, adopt_cache_manifest
+
+        monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+        cache_mod.reset_default_store()
+        try:
+            spill = tmp_path / "spill"
+            spill.mkdir()
+            path = str(tmp_path / "loader.json")
+            LoaderCheckpoint(
+                cache_spill_dir=str(spill),
+                cache_key_schema=KEY_SCHEMA_VERSION,
+            ).save(path)
+            assert adopt_cache_manifest(path)
+            assert os.environ["DDL_TPU_CACHE_SPILL_DIR"] == str(spill)
+            # Missing / manifest-less checkpoints: cold cache, no error.
+            assert not adopt_cache_manifest(str(tmp_path / "nope.json"))
+            LoaderCheckpoint().save(path)
+            assert not adopt_cache_manifest(path)
+        finally:
+            cache_mod.reset_default_store()
+            monkeypatch.delenv("DDL_TPU_CACHE_SPILL_DIR", raising=False)
+
+    def test_old_checkpoints_still_load(self, tmp_path):
+        """Pre-cache JSON (no manifest fields) loads with defaults."""
+        import json
+
+        from ddl_tpu.checkpoint import LoaderCheckpoint
+
+        p = tmp_path / "old.json"
+        p.write_text(json.dumps(
+            {"epoch": 1, "target": 2, "batches_in_window": 3,
+             "shuffle_round": 4}
+        ))
+        ck = LoaderCheckpoint.load(str(p))
+        assert ck.epoch == 1 and ck.cache_spill_dir is None
+
+
+class TestCacheFalseOverride:
+    def test_cache_false_wins_over_env_gate(self, tmp_path, monkeypatch):
+        """cache=False forces the cache off even with DDL_TPU_CACHE=1 —
+        the bench's uncached control arm depends on it."""
+        from ddl_tpu import cache as cache_mod
+
+        pattern = _npy_shards(tmp_path, n=2)
+        monkeypatch.setenv("DDL_TPU_CACHE", "1")
+        cache_mod.reset_default_store()
+        try:
+            p = FileShardProducer(pattern, cache=False, warm=False)
+            p.on_init(producer_idx=1)
+            assert p._cache is None
+            p2 = FileShardProducer(pattern, warm=False)  # None: env-gated
+            p2.on_init(producer_idx=1)
+            assert p2._cache is not None
+        finally:
+            cache_mod.reset_default_store()
+
+
+class TestConfigExport:
+    def test_config_cache_fields_export_to_env(self, monkeypatch):
+        """A LoaderConfig with cache on mirrors its fields into the
+        DDL_TPU_CACHE* environment ahead of the producer spawn, so
+        PROCESS-mode workers build the same store from what they
+        inherit."""
+        from ddl_tpu.config import LoaderConfig
+        from ddl_tpu.env import _export_cache_knobs
+
+        for k in ("DDL_TPU_CACHE", "DDL_TPU_CACHE_RAM_MB",
+                  "DDL_TPU_CACHE_SPILL_DIR", "DDL_TPU_CACHE_SPILL_MB",
+                  "DDL_TPU_CACHE_WARM"):
+            monkeypatch.delenv(k, raising=False)
+        _export_cache_knobs(LoaderConfig())  # cache off, clean env: no-op
+        assert "DDL_TPU_CACHE" not in os.environ
+        _export_cache_knobs(None)            # no config: no opinion
+        assert "DDL_TPU_CACHE" not in os.environ
+        cfg = LoaderConfig(
+            cache=True, cache_ram_mb=64, cache_spill_dir="/tmp/spill",
+            cache_spill_mb=128, cache_warm=False,
+        )
+        _export_cache_knobs(cfg)
+        assert os.environ["DDL_TPU_CACHE"] == "1"
+        assert os.environ["DDL_TPU_CACHE_RAM_MB"] == "64"
+        assert os.environ["DDL_TPU_CACHE_SPILL_DIR"] == "/tmp/spill"
+        assert os.environ["DDL_TPU_CACHE_SPILL_MB"] == "128"
+        assert os.environ["DDL_TPU_CACHE_WARM"] == "0"
+        # The mirror goes both ways: a later cache-on config WITHOUT a
+        # spill dir clears the stale export, and a cache-off config
+        # overrides (config wins over env) rather than inheriting.
+        _export_cache_knobs(LoaderConfig(cache=True))
+        assert "DDL_TPU_CACHE_SPILL_DIR" not in os.environ
+        _export_cache_knobs(LoaderConfig(cache=False))
+        assert os.environ["DDL_TPU_CACHE"] == "0"
+
+
+class TestEndToEnd:
+    """Cache through the full THREAD-mode pipeline: same batches served
+    with the cache on and off, warmer stopped by producer teardown."""
+
+    def _run(self, pattern, cache_store):
+        from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                FileShardProducer(
+                    pattern, seed=11, cache=cache_store,
+                    warm=cache_store is not None,
+                ),
+                batch_size=8, connection=env.connection, n_epochs=2,
+                output="numpy",
+            )
+            out = []
+            for _ in range(2):
+                for batch in loader:
+                    out.append(np.concatenate([c.ravel() for c in batch]))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return np.concatenate(out)
+
+        return main()
+
+    def test_loader_stream_identical_and_no_leaked_threads(self, tmp_path):
+        pattern = _npy_shards(tmp_path, n=4, rows=16, cols=8)
+        plain = self._run(pattern, None)
+        store, m = _store()
+        before = {t.name for t in threading.enumerate()}
+        cached = self._run(pattern, store)
+        assert np.array_equal(plain, cached)
+        assert m.counter("cache.hits") > 0
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            leaked = {
+                t.name for t in threading.enumerate()
+                if "warmer" in t.name and t.is_alive()
+            } - before
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked

@@ -188,6 +188,10 @@ class TestNorthStarReport:
             "respawns", "watchdog_failures", "corrupt_windows",
             "replays", "shuffle_degraded", "staging_retries",
             "inline_fallbacks",
+            # shard-cache extras (ISSUE 4: ddl_tpu.cache tiers)
+            "cache_hits", "cache_misses", "cache_evictions",
+            "cache_spills", "cache_spill_hits", "cache_quarantined",
+            "cache_resident_bytes", "cache_resident_bytes_max",
         }
         assert r["samples_per_sec"] > 0
 

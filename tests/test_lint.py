@@ -138,6 +138,14 @@ VIOLATIONS = {
             worker.join()        # ditto
             return q.get()       # ditto (empty queue)
     """,
+    "DDL013": """
+        _shard_cache = {}
+
+        def decoded(path):
+            if path not in _shard_cache:
+                _shard_cache[path] = _load(path)   # append-only memo
+            return _shard_cache[path]
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -252,6 +260,30 @@ CLEAN = {
             color = cfg.get("color")            # dict.get has an argument
             return q.get(timeout=5.0), sep, color
     """,
+    "DDL013": """
+        _BUDGET = 8
+        _shard_cache = {}          # evicted below: bounded
+        _REGISTRY = {}             # grown only at import time: not runtime
+
+        _REGISTRY["local"] = object()
+
+        def decoded(path):
+            if path not in _shard_cache:
+                if len(_shard_cache) >= _BUDGET:
+                    _shard_cache.pop(next(iter(_shard_cache)))
+                _shard_cache[path] = _load(path)
+            return _shard_cache[path]
+
+        class Counters:
+            def __init__(self):
+                self._counts = {}
+
+            def incr(self, name):
+                self._counts[name] = self._counts.get(name, 0) + 1
+
+            def reset(self):
+                self._counts.clear()   # reset site: bounded
+    """,
 }
 
 
@@ -303,6 +335,39 @@ class TestSelfTest:
         """
         findings = lint_snippet(tmp_path, "DDL007", src)
         assert [f.code for f in findings] == ["DDL007"]
+
+    def test_ddl013_instance_level_cache_is_flagged(self, tmp_path):
+        """`self.attr = {}` grown across methods with no eviction fires
+        too — the instance-scoped variant of the module-level fixture."""
+        src = """
+            class ShardIndex:
+                def __init__(self):
+                    self._by_path = {}
+
+                def lookup(self, path):
+                    entry = self._by_path.setdefault(path, _load(path))
+                    return entry
+        """
+        findings = lint_snippet(tmp_path, "DDL013", src)
+        assert [f.code for f in findings] == ["DDL013"]
+        assert "ShardIndex._by_path" in findings[0].message
+
+    def test_ddl013_rebind_inside_function_counts_as_reset(self, tmp_path):
+        """A method that reassigns the dict (epoch-boundary reset) bounds
+        it — the rebind is an eviction site, not a second definition."""
+        src = """
+            class WindowIndex:
+                def __init__(self):
+                    self._windows = {}
+
+                def add(self, k, v):
+                    self._windows[k] = v
+
+                def roll_epoch(self):
+                    self._windows = {}
+        """
+        findings = lint_snippet(tmp_path, "DDL013", src)
+        assert findings == [], findings
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

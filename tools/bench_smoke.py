@@ -35,6 +35,16 @@ REQUIRED_ROBUSTNESS = (
     "respawns", "watchdog_failures", "corrupt_windows", "replays",
     "shuffle_degraded", "staging_retries", "inline_fallbacks",
 )
+#: Shard-cache cold/warm A/B block (ddl_tpu/cache, docs/CACHING.md).
+REQUIRED_CACHE = (
+    "hits", "misses", "evictions", "resident_bytes_max",
+    "cold_samples_per_sec", "warm_samples_per_sec", "warm_vs_cold",
+    "byte_identical",
+)
+#: The warm tier must beat the throttled cold path by at least this
+#: factor (ISSUE 4 acceptance; the measured margin is ~40x on the
+#: default 20 ms-latency geometry, so 2.0 is noise-proof).
+MIN_WARM_VS_COLD = 2.0
 
 
 def main() -> int:
@@ -82,6 +92,11 @@ def main() -> int:
             for k in REQUIRED_ROBUSTNESS
             if k not in robustness
         ]
+    cache = result.get("cache")
+    if not isinstance(cache, dict):
+        missing.append("cache")
+    else:
+        missing += [f"cache.{k}" for k in REQUIRED_CACHE if k not in cache]
     if "ingest_inline" not in result and "errors" not in result:
         missing.append("ingest_inline")
     if missing:
@@ -93,12 +108,31 @@ def main() -> int:
         print("bench-smoke: headline value is null "
               f"(errors={result.get('errors')})")
         return 1
+    # The cache A/B is an ASSERTED contract, not just a present one: a
+    # warm tier that stopped winning (or — worse — stopped serving the
+    # same bytes) is a regression this gate exists to catch.
+    if isinstance(cache, dict) and not [k for k in missing if "cache" in k]:
+        if cache["byte_identical"] is not True:
+            print(json.dumps(result, indent=1))
+            print("bench-smoke: cached stream NOT byte-identical to "
+                  "uncached — the cache changed data")
+            return 1
+        if cache["warm_vs_cold"] < MIN_WARM_VS_COLD:
+            print(json.dumps(result, indent=1))
+            print(
+                "bench-smoke: warm epoch only "
+                f"{cache['warm_vs_cold']}x cold (< {MIN_WARM_VS_COLD}x) "
+                "over the throttled backend"
+            )
+            return 1
     staged = result["value"]
     inline = result.get("ingest_inline", {}).get("samples_per_sec")
     print(
         "bench-smoke: OK — staged "
         f"{staged} vs inline {inline} samples/s; staging + robustness "
-        "extras present"
+        "extras present; cache warm/cold "
+        f"{cache.get('warm_vs_cold') if isinstance(cache, dict) else '?'}x "
+        "byte-identical"
     )
     return 0
 

@@ -1,6 +1,7 @@
 """Checker registry: importing this package registers every checker."""
 
 from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
+    caches,
     concurrency,
     ingest_path,
     jax_hazards,

@@ -1,0 +1,221 @@
+"""Unbounded-growth hazards: dict caches with no eviction or budget.
+
+The shard cache (``ddl_tpu/cache/store.py``) made "cache" a first-class
+concept in this tree — and with it, the classic leak shape: a
+module-level or instance-level dict used as a memo that only ever grows.
+On a long-running producer (millions of users north star) an append-only
+mapping IS an OOM with a fuse, and it passes every short test.  DDL013
+makes the shape a lint failure at introduction time instead of a
+production pager months later.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set, Tuple
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Constructors whose result is a growable mapping.
+_DICT_CTORS = {"dict", "defaultdict", "OrderedDict", "Counter"}
+
+#: Mapping methods that remove or reset entries — any one of them (or a
+#: ``del d[k]`` / reassignment inside a function) counts as an eviction
+#: site and clears the candidate.
+_SHRINK_METHODS = {"pop", "popitem", "clear"}
+
+#: Mapping methods that insert (beyond subscript assignment).
+_GROW_METHODS = {"setdefault"}
+
+#: Candidate identity: ``("", name)`` for a module-level dict,
+#: ``(ClassName, attr)`` for a ``self.<attr>`` dict.
+_CandKey = Tuple[str, str]
+
+
+def _is_dict_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return last_segment(node.func) in _DICT_CTORS
+    return False
+
+
+@register
+class UnboundedDictCache(Checker):
+    """DDL013: module/instance dict caches must evict or carry a budget.
+
+    A **candidate** is a dict-valued binding at module scope
+    (``_cache = {}``) or instance scope (``self._cache = {}`` in any
+    method).  A candidate is flagged when some function **grows** it —
+    ``d[k] = v`` / ``d[k] += v`` / ``d.setdefault(...)`` — and *nothing
+    anywhere in the module* shrinks or resets it: no ``.pop()`` /
+    ``.popitem()`` / ``.clear()``, no ``del d[k]``, and no reassignment
+    inside a function (a rebind is a reset).  Growth only at import /
+    construction time is not runtime growth and stays clean.
+
+    This is a heuristic about *shape*, not a proof about *size*: a dict
+    keyed by a closed set (e.g. per-spec hit counters) is bounded by
+    construction — take the pragma escape on the defining line with a
+    rationale::
+
+        self._hits: Dict[int, int] = {}  # ddl-lint: disable=DDL013 - bounded by len(specs)
+
+    The sanctioned fix for real caches is a byte/entry budget with LRU
+    eviction — ``ddl_tpu.cache.CacheStore`` is the in-tree example (its
+    RAM tier both grows and ``popitem``\\ s, so it passes).
+    """
+
+    code = "DDL013"
+    summary = "unbounded module/instance-level dict cache (no eviction)"
+
+    def run(self):
+        tree = self.ctx.tree
+        candidates: Dict[_CandKey, ast.AST] = {}
+        self._collect_module_candidates(tree, candidates)
+        self._collect_instance_candidates(tree, candidates)
+        if not candidates:
+            return self.findings
+
+        grows: Set[_CandKey] = set()
+        shrinks: Set[_CandKey] = set()
+        for fn in (
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ):
+            cls = self._enclosing_class(fn)
+            for node in ast.walk(fn):
+                self._scan(node, cls, candidates, grows, shrinks)
+
+        for key in sorted(grows - shrinks):
+            scope, name = key
+            label = f"{scope}.{name}" if scope else name
+            self.report(
+                candidates[key],
+                f"dict cache {label!r} grows at runtime with no "
+                "eviction/reset anywhere in the module; give it a "
+                "budget + eviction (see ddl_tpu.cache.CacheStore) or "
+                "pragma a bounded-by-construction case with a rationale",
+            )
+        return self.findings
+
+    # -- candidate collection ----------------------------------------------
+
+    def _collect_module_candidates(
+        self, tree: ast.Module, out: Dict[_CandKey, ast.AST]
+    ) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if not _is_dict_ctor(value):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out[("", t.id)] = node
+
+    def _collect_instance_candidates(
+        self, tree: ast.Module, out: Dict[_CandKey, ast.AST]
+    ) -> None:
+        for cls in (
+            n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+        ):
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif (
+                    isinstance(node, ast.AnnAssign)
+                    and node.value is not None
+                ):
+                    value, targets = node.value, [node.target]
+                else:
+                    continue
+                if not _is_dict_ctor(value):
+                    continue
+                for t in targets:
+                    attr = self._self_attr(t)
+                    if attr is not None:
+                        out.setdefault((cls.name, attr), node)
+
+    # -- usage scan --------------------------------------------------------
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _resolve(
+        self,
+        node: ast.AST,
+        cls: Optional[str],
+        candidates: Dict[_CandKey, ast.AST],
+    ) -> Optional[_CandKey]:
+        """Map an expression to the candidate it names, if any."""
+        if isinstance(node, ast.Name) and ("", node.id) in candidates:
+            return ("", node.id)
+        attr = self._self_attr(node)
+        if attr is not None and cls and (cls, attr) in candidates:
+            return (cls, attr)
+        return None
+
+    def _enclosing_class(self, fn: ast.AST) -> Optional[str]:
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+        return None
+
+    def _scan(
+        self,
+        node: ast.AST,
+        cls: Optional[str],
+        candidates: Dict[_CandKey, ast.AST],
+        grows: Set[_CandKey],
+        shrinks: Set[_CandKey],
+    ) -> None:
+        # d[k] = v / d[k] += v
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    key = self._resolve(t.value, cls, candidates)
+                    if key is not None:
+                        grows.add(key)
+                else:
+                    # Rebind inside a function = reset (a shrink) —
+                    # unless this IS the candidate's defining statement
+                    # (an instance candidate's `self.x = {}` in
+                    # __init__ defines, it does not evict).
+                    key = self._resolve(t, cls, candidates)
+                    if key is not None and candidates[key] is not node:
+                        shrinks.add(key)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            key = self._resolve(node.target, cls, candidates)
+            if key is not None and candidates[key] is not node:
+                shrinks.add(key)
+        # d.setdefault(...) / d.pop(...) / d.clear() / d.popitem()
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            key = self._resolve(node.func.value, cls, candidates)
+            if key is not None:
+                if node.func.attr in _GROW_METHODS:
+                    grows.add(key)
+                elif node.func.attr in _SHRINK_METHODS:
+                    shrinks.add(key)
+        # del d[k]
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    key = self._resolve(t.value, cls, candidates)
+                    if key is not None:
+                        shrinks.add(key)

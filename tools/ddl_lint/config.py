@@ -37,6 +37,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL010",  # jax.jit constructed inside a loop
     "DDL011",  # fresh staging copy/allocation in an ingest hot path
     "DDL012",  # unbounded blocking wait (no timeout) on a framework path
+    "DDL013",  # unbounded module/instance-level dict cache (no eviction)
 )
 
 
@@ -54,7 +55,9 @@ class LintConfig:
     #: Declared lock hierarchy, outermost first.  A ``with`` acquiring a
     #: lock while one LATER in this list is held is DDL006.
     lock_order: List[str] = dataclasses.field(
-        default_factory=lambda: ["_build_lock", "_cond", "_lock", "_sweep_lock"]
+        default_factory=lambda: [
+            "_build_lock", "_cond", "_lock", "_sweep_lock", "_spill_lock",
+        ]
     )
     #: Functions (bare name or ``Class.method``) forming the per-batch
     #: ingest feed into ``device_put``: fresh copies/allocations inside
