@@ -58,29 +58,99 @@ class LoaderConfig:
     @classmethod
     def load(cls, path: Optional[str] = None, **overrides: Any) -> "LoaderConfig":
         """defaults → JSON file → env (`DDL_TPU_<FIELD>`) → kwargs."""
-        values: dict = {}
-        if path:
-            with open(path) as f:
-                loaded = json.load(f)
-            unknown = set(loaded) - {f.name for f in dataclasses.fields(cls)}
-            if unknown:
-                raise ValueError(f"unknown config keys in {path}: {sorted(unknown)}")
-            values.update(loaded)
-        for field in dataclasses.fields(cls):
-            if field.name.startswith("_"):
-                continue
-            env = os.environ.get(cls._ENV_PREFIX + field.name.upper())
-            if env is not None:
-                values[field.name] = _coerce(env, field.type)
-        values.update(overrides)
-        return cls(**values)
+        return _load_layered(cls, path, overrides)
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(dataclasses.asdict(self), f, indent=2)
+        _save_json(self, path)
 
     def run_mode(self) -> RunMode:
         return RunMode(self.mode)
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Training hot-path knobs — the consumer-compute half of a run
+    (the model/trainer twin of :class:`LoaderConfig`), env-overridable
+    as ``DDL_TPU_TRAIN_<FIELD>``.
+
+    ``remat`` names the rematerialisation policy
+    (:mod:`ddl_tpu.models.remat`: none/full/selective/dots) and is
+    applied to a model config with :meth:`model_config`; ``schedule`` /
+    ``pp_chunks`` select the pipeline schedule
+    (:func:`ddl_tpu.parallel.pipeline_apply`) and feed the models'
+    ``*_pp`` entry points via :meth:`pipeline_kwargs`; ``accum_steps``
+    flows into the :class:`~ddl_tpu.trainer.Trainer` constructor.
+    """
+
+    #: Remat policy for the backward pass (``ddl_tpu.models.remat``).
+    remat: str = "none"
+    #: Pipeline schedule: "gpipe" or "1f1b" (interleaved stage chunks).
+    schedule: str = "gpipe"
+    #: Stage chunks per device for 1f1b (0 = the schedule's default, 2).
+    pp_chunks: int = 0
+    #: Microbatches per pipeline step (1 = no microbatching).
+    n_microbatches: int = 1
+    #: Gradient-accumulation microbatches per optimizer update.
+    accum_steps: int = 1
+
+    _ENV_PREFIX = "DDL_TPU_TRAIN_"
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, **overrides: Any) -> "TrainConfig":
+        """defaults → JSON file → env (`DDL_TPU_TRAIN_<FIELD>`) → kwargs."""
+        cfg = _load_layered(cls, path, overrides)
+        from ddl_tpu.models import remat as _remat
+
+        _remat.resolve(cfg.remat)  # fail on junk at load time
+        if cfg.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {cfg.schedule!r}")
+        return cfg
+
+    def save(self, path: str) -> None:
+        _save_json(self, path)
+
+    def model_config(self, model_cfg: Any) -> Any:
+        """The model config with this TrainConfig's remat policy applied
+        (works on any of the frozen model config dataclasses)."""
+        return dataclasses.replace(model_cfg, remat=self.remat)
+
+    def pipeline_kwargs(self) -> dict:
+        """kwargs for the models' ``*_pp`` losses / ``pipeline_apply``."""
+        return {
+            "schedule": self.schedule,
+            "n_chunks": self.pp_chunks or None,
+        }
+
+
+def _load_layered(cls: Any, path: Optional[str], overrides: dict) -> Any:
+    """THE layered-config loader both config classes share: defaults →
+    JSON file → env (``<cls._ENV_PREFIX><FIELD>``) → kwargs, later
+    layers winning, unknown JSON keys rejected.  One implementation so
+    the layering/coercion semantics cannot drift between
+    :class:`LoaderConfig` and :class:`TrainConfig`."""
+    values: dict = {}
+    if path:
+        with open(path) as f:
+            loaded = json.load(f)
+        unknown = set(loaded) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(
+                f"unknown config keys in {path}: {sorted(unknown)}"
+            )
+        values.update(loaded)
+    for field in dataclasses.fields(cls):
+        if field.name.startswith("_"):
+            continue
+        env = os.environ.get(cls._ENV_PREFIX + field.name.upper())
+        if env is not None:
+            values[field.name] = _coerce(env, field.type)
+    values.update(overrides)
+    return cls(**values)
+
+
+def _save_json(cfg: Any, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(dataclasses.asdict(cfg), f, indent=2)
 
 
 def _coerce(raw: str, annot: Any) -> Any:

@@ -47,6 +47,21 @@ from ddl_tpu.utils import for_all_methods, with_logging
 logger = logging.getLogger("ddl_tpu")
 
 
+def _transfer_ready(dev: Any) -> bool:
+    """Non-blocking transfer-completion probe on a device value (a jax
+    array or tuple/pytree of them).  Arrays without ``is_ready`` (older
+    jax) report not-ready — the caller's forced flush still blocks
+    correctly, the fast path just never triggers."""
+    try:
+        import jax
+
+        return all(
+            bool(leaf.is_ready()) for leaf in jax.tree.leaves(dev)
+        )
+    except AttributeError:
+        return False
+
+
 class _CorruptAhead(Exception):
     """Internal: integrity verification failed on a LOOKAHEAD acquire.
 
@@ -109,6 +124,16 @@ class DistributedDataLoader:
         # lookahead survives here, so the next stream serves it instead
         # of losing it (the break-resume contract, kept under staging).
         self._staged_orphans: "list" = []
+        # Inline-stream windows already YIELDED whose ring slots are
+        # still held pending transfer completion: [target, slot, dev]
+        # in yield (== per-ring FIFO) order.  The old stream blocked the
+        # host on every window's transfer before yielding it
+        # (``jax.block_until_ready``), serializing window k+1's H2D
+        # against window k's scanned optimizer steps (VERDICT r5 weak
+        # #4); release is now gated on a non-blocking readiness probe,
+        # with forced (blocking) flushes only where the ring actually
+        # needs the slot back.
+        self._release_backlog: "list" = []
         if output == "jax":
             from ddl_tpu.ingest import DeviceIngestor
 
@@ -295,8 +320,15 @@ class DistributedDataLoader:
           and the same ``nslots`` sustains a deeper in-flight pipeline.
         - **Inline** (``DDL_TPU_STAGED=0``, and the default on the CPU
           client): each window's transfer sources the ring slot directly
-          (no host memcpy anywhere between producer fill and HBM) and
-          the slot is released only once the transfer has completed.
+          (no host memcpy anywhere between producer fill and HBM).  The
+          slot is still owned until the transfer completes, but the
+          HOST never blocks on that: windows yield as async device
+          values and slot release is gated on a transfer-completion
+          probe (forced only when the ring runs out of slots), so
+          window k+1's H2D overlaps window k's compute instead of
+          serializing behind a per-window ``block_until_ready``.  (On
+          the CPU client ``put_window`` detaches the source with its
+          alias-guard copy, so slots release at yield.)
 
         Either way the next window's transfer streams while the caller's
         compute on the current one runs.  This is the TPU analog of the
@@ -346,6 +378,12 @@ class DistributedDataLoader:
         )
 
         held: collections.Counter = collections.Counter()
+        # A previous stream's yielded-but-unreleased windows still hold
+        # ring slots; count them so this stream's drain-lookahead
+        # accounting (acquire_drain_ahead(held)) skips past them, and
+        # sweep them out as their transfers complete.
+        for _t, _slot, _dev in self._release_backlog:
+            held[_t] += 1
         # FIFO of [slot, target, payload, samples, slot_released] with
         # transfers in flight; at most 1 + lookahead entries.  payload is
         # a device array (inline) or a StagedTransfer handle (staged).
@@ -487,16 +525,31 @@ class DistributedDataLoader:
                 )
             else:
                 dev = payload
-                # The slot stays ours until the bytes are on device; only
-                # then may the producer overwrite it.
-                jax.block_until_ready(dev)
             self.metrics.incr("ingest.bytes", float(dev.nbytes))
             self.metrics.incr("ingest.windows")
             self.metrics.incr("consumer.windows")
             self.metrics.incr("consumer.samples", served)
             if not released:
-                self.connection.rings[target].release(slot)
-                held[target] -= 1
+                if not isinstance(payload, StagedTransfer) and (
+                    not self._ingestor.window_source_detached()
+                ):
+                    # Inline on an accelerator: the transfer sources the
+                    # ring slot, so the slot must outlive the DMA — but
+                    # the HOST need not wait for it.  The old
+                    # ``block_until_ready`` here serialized window k+1's
+                    # H2D against window k's scanned optimizer steps
+                    # (VERDICT r5 weak #4); release is instead deferred
+                    # onto the transfer-completion probe
+                    # (``_sweep_release_backlog``), forced only when the
+                    # ring runs out of slots.
+                    self._release_backlog.append([target, slot, dev])
+                else:
+                    # Staged payload (copy+dispatch already awaited) or
+                    # inline with a DETACHED source (the CPU client's
+                    # alias-guard copy in ``put_window``): nothing reads
+                    # the slot anymore, hand it back now.
+                    self.connection.rings[target].release(slot)
+                    held[target] -= 1
             elif self._staged_orphans and self._staged_orphans[0] is entry:
                 # Yielded after its early release: no longer an orphan.
                 self._staged_orphans.pop(0)
@@ -522,7 +575,21 @@ class DistributedDataLoader:
             check_live()
             if self._finalized:
                 break
+            if self._release_backlog:
+                # Free completed-transfer slots (non-blocking probe)
+                # before acquiring or deepening.
+                self._sweep_release_backlog(held)
             if not pending:
+                if (
+                    held[cursor]
+                    >= self.connection.rings[cursor].nslots
+                ):
+                    # Every slot of the head ring is either in flight or
+                    # awaiting its transfer-gated release: the blocking
+                    # acquire below could never be satisfied (the
+                    # producer has no free slot to commit into) — wait
+                    # out the OLDEST deferred transfer on that ring.
+                    self._flush_release_backlog(held, target=cursor)
                 pending.append(start_one(self.timeout_s))
             if engine is not None:
                 # Free completed-copy slots BEFORE deepening: an early
@@ -622,6 +689,52 @@ class DistributedDataLoader:
             .view(self.dtypes[target])
             .reshape(self.shapes[target])
         )
+
+    # -- deferred (transfer-gated) slot release ----------------------------
+
+    def _sweep_release_backlog(self, held=None) -> None:
+        """Release yielded inline-stream windows whose transfers have
+        COMPLETED (non-blocking ``is_ready`` probe), in per-ring FIFO
+        order — a not-yet-ready transfer blocks only later entries of
+        the same ring.  ``held`` (the live stream's per-target hold
+        counter) is decremented alongside each release."""
+        blocked: set = set()
+        remaining = []
+        for entry in self._release_backlog:
+            target, slot, dev = entry
+            if target not in blocked and _transfer_ready(dev):
+                self.connection.rings[target].release(slot)
+                if held is not None:
+                    held[target] -= 1
+            else:
+                blocked.add(target)
+                remaining.append(entry)
+        self._release_backlog = remaining
+
+    def _flush_release_backlog(self, held=None, target=None) -> None:
+        """BLOCKING release of backlog entries: all of them (stream
+        teardown / path switches), or only the oldest entry of
+        ``target`` (a ring out of free slots).  The wait is the
+        transfer completing — accounted as ``ingest.release_wait`` so
+        a stream losing its overlap shows up in the north-star report
+        instead of hiding inside opaque wall time."""
+        import jax
+
+        remaining = []
+        done = False
+        for entry in self._release_backlog:
+            t, slot, dev = entry
+            if done or (target is not None and t != target):
+                remaining.append(entry)
+                continue
+            with self.metrics.timed("ingest.release_wait"):
+                jax.block_until_ready(dev)
+            self.connection.rings[t].release(slot)
+            if held is not None:
+                held[t] -= 1
+            if target is not None:
+                done = True
+        self._release_backlog = remaining
 
     # -- end-to-end integrity (ddl_tpu.integrity) --------------------------
 
@@ -769,6 +882,11 @@ class DistributedDataLoader:
     def _acquire_current(self) -> None:
         from ddl_tpu.profiling import annotate
 
+        if self._release_backlog:
+            # Batch-path acquire tracks no per-stream hold counter, so a
+            # stream's deferred releases must land first — otherwise the
+            # drain-head acquire below would re-serve their slots.
+            self._flush_release_backlog()
         if self._staged_orphans:
             # The next unserved windows live in staging buffers (an
             # abandoned staged stream released their slots early); the
@@ -794,6 +912,8 @@ class DistributedDataLoader:
         deterministically from their seeds, so skipping the windows the
         pre-checkpoint run consumed puts the pipeline at the exact data
         position where it stopped (one window per epoch — Q7 semantics)."""
+        if self._release_backlog:
+            self._flush_release_backlog()
         for _ in range(n_windows):
             if self._staged_orphans:
                 # Early-released staged window: already off the ring;
@@ -827,6 +947,9 @@ class DistributedDataLoader:
         if self._finalized:
             return
         self._finalized = True
+        # Deferred stream releases first: their transfers must complete
+        # (and their slots return) before the rings go away.
+        self._flush_release_backlog()
         self._release_current()
         if self._ingestor is not None:
             # Stop the staging executor BEFORE the rings go away: pending

@@ -248,6 +248,16 @@ class DeviceIngestor:
             self.metrics.incr("ingest.windows")
         return out
 
+    def window_source_detached(self) -> bool:
+        """Does :meth:`put_window` detach the transfer from its host
+        source?  True on the CPU client, whose alias-guard copy means
+        the returned array never reads the ring slot again — the caller
+        may release the slot immediately at yield.  On accelerators the
+        transfer sources the slot directly (zero-copy), so release must
+        wait for transfer completion (``DistributedDataLoader``'s
+        readiness-gated backlog)."""
+        return self._target_platform() == "cpu"
+
     def _target_platform(self) -> str:
         if self.sharding is not None:
             dev = next(iter(self.sharding.device_set))
@@ -334,6 +344,20 @@ def north_star_report(
     report["pool_hits"] = m.counter("staging.pool_hits")
     report["pool_misses"] = m.counter("staging.pool_misses")
     report["queue_depth_max"] = m.gauge("staging.queue_depth.max")
+    # Training hot-path observability (ISSUE 5): time the trainer's
+    # stream loop spent waiting for the next window (overlap health —
+    # near zero when H2D hides behind the scans), time the loader spent
+    # in FORCED transfer-completion waits before slot release, and the
+    # analytic bubble/chunking of the last-compiled pipeline schedule.
+    report["window_wait_s"] = m.timer("trainer.window_wait").total_s
+    report["release_wait_s"] = m.timer("ingest.release_wait").total_s
+    # The pp gauges are PROCESS-level trace-time facts (pipeline_apply
+    # records them once per compilation, on the default registry — it
+    # cannot see a run's private registry), so read them from the
+    # default registry even when reporting a private one; otherwise
+    # every private-registry run reports 0.0 for a schedule that ran.
+    report["pp_bubble"] = default_metrics().gauge("pp.bubble")
+    report["pp_chunks"] = default_metrics().gauge("pp.chunks")
     # Robustness observability (ISSUE 3): recovery events must be visible
     # in the report and the bench JSON trajectories, not just in logs —
     # a "passing" run that silently replayed half its windows is a

@@ -43,12 +43,16 @@ class LlamaConfig:
     #: geometries — gradient accumulation stays exact either way, the
     #: train step accumulates in fp32).
     param_dtype: Any = jnp.float32
-    #: Rematerialise each transformer layer in the backward pass
-    #: (``jax.checkpoint``): activation memory drops from O(n_layers)
-    #: full layer internals to O(n_layers) residual-stream tensors plus
-    #: ONE layer's internals — the standard FLOPs-for-HBM trade that
-    #: lets long-sequence/big-model configs fit a single chip.
-    remat: bool = False
+    #: Rematerialisation policy for the backward pass
+    #: (:mod:`ddl_tpu.models.remat`): ``"none"`` | ``"full"`` (save only
+    #: each layer's residual-stream input, recompute everything — the
+    #: classic FLOPs-for-HBM trade that lets long-sequence/big-model
+    #: configs fit a single chip) | ``"selective"`` (additionally save
+    #: the attention outputs so the backward never re-runs the attention
+    #: kernel — buys back most of full-remat's MFU loss) | ``"dots"``
+    #: (save all non-batched matmul outputs).  Bools accepted for back
+    #: compat: ``True`` == ``"full"``, ``False`` == ``"none"``.
+    remat: Any = False
     # "auto": Pallas flash attention on TPU, dense elsewhere; "flash"/"dense"
     # force one path.  Sequence-parallel meshes always use ring attention.
     attn_impl: str = "auto"
@@ -59,6 +63,9 @@ class LlamaConfig:
                 f"attn_impl must be 'auto', 'flash', or 'dense', "
                 f"got {self.attn_impl!r}"
             )
+        from ddl_tpu.models import remat as _remat
+
+        _remat.resolve(self.remat)  # fail on junk at config build time
 
     @property
     def head_dim(self) -> int:
@@ -186,11 +193,11 @@ def forward(
             layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
         )
 
-    if cfg.remat:
-        # Save only each layer's residual-stream input; recompute the
-        # layer internals in the backward pass (HBM-for-FLOPs — the knob
-        # that fits big-model/long-seq geometries on one chip).
-        layer_fn = jax.checkpoint(layer_fn)
+    # The configured remat policy (none/full/selective/dots —
+    # ddl_tpu.models.remat): what the backward pass saves vs recomputes.
+    from ddl_tpu.models import remat as _remat
+
+    layer_fn = _remat.wrap(layer_fn, cfg.remat)
     for layer in params["layers"]:
         x = layer_fn(x, layer)
 
@@ -224,6 +231,11 @@ def _attn_block(
         q, k, v, mesh=mesh, impl=cfg.attn_impl, causal=True,
         kv_repeat=rep, segment_ids=segment_ids,
     )
+    # Saveable under remat="selective" (identity otherwise): the
+    # backward pass then never re-runs the attention kernel.
+    from ddl_tpu.models import remat as _remat
+
+    attn = _remat.tag_attn_out(attn)
     return x + attn.reshape(B, T, -1) @ layer["wo"].astype(dt)
 
 
@@ -570,15 +582,20 @@ def next_token_loss(
 # -- pipeline parallelism ----------------------------------------------------
 
 
-def stage_params(params: Params, n_stages: int) -> Params:
+def stage_params(
+    params: Params, n_stages: int, n_chunks: int = 1
+) -> Params:
     """Rearrange a :func:`init_params` pytree for pipeline parallelism.
 
     The ``n_layers`` per-layer dicts regroup into ``n_stages`` equal
     stages and stack into leaves with leading ``(S, L/S)`` axes —
     :func:`ddl_tpu.parallel.pipeline_apply`'s stacked-stage layout, with
     the S axis sharded over ``pp`` so each device stores only its own
-    stage's layers.  Embedding, final norm and lm head stay outside the
-    pipe (they run replicated over pp, before/after the schedule).
+    stage's layers.  ``n_chunks > 1`` builds the interleaved
+    ``(S, V, L/(S·V))`` layout for ``schedule="1f1b"`` (device d chunk c
+    holds global stage c·S+d).  Embedding, final norm and lm head stay
+    outside the pipe (they run replicated over pp, before/after the
+    schedule).
 
     Inverse-free by design: training checkpoints save THIS layout; the
     non-pp layout is only an initialization convenience.
@@ -587,22 +604,29 @@ def stage_params(params: Params, n_stages: int) -> Params:
 
     return {
         "embed": params["embed"],
-        "stages": stack_layer_stages(params["layers"], n_stages),
+        "stages": stack_layer_stages(
+            params["layers"], n_stages, n_chunks=n_chunks
+        ),
         "final_norm": params["final_norm"],
         "lm_head": params["lm_head"],
     }
 
 
-def pp_param_specs(cfg: LlamaConfig, axis: str = "pp") -> Params:
+def pp_param_specs(
+    cfg: LlamaConfig, axis: str = "pp", n_chunks: int = 1
+) -> Params:
     """PartitionSpecs for the :func:`stage_params` layout: ``pp`` shards
     the stage axis (at-rest storage is one stage per pp group), the
-    per-stage layer axis is unsharded, and the trailing axes keep the
-    Megatron fsdp/tp layout of :func:`param_specs`."""
+    chunk (1f1b only) and per-stage layer axes are unsharded, and the
+    trailing axes keep the Megatron fsdp/tp layout of
+    :func:`param_specs`."""
     from ddl_tpu.parallel.pipeline import stage_spec_tree
 
     return {
         "embed": P(None, "fsdp"),
-        "stages": stage_spec_tree(param_specs(cfg)["layers"][0], axis),
+        "stages": stage_spec_tree(
+            param_specs(cfg)["layers"][0], axis, n_chunks=n_chunks
+        ),
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }
@@ -644,6 +668,9 @@ def _layer_apply_tp_local(
         q, k, v, mesh=None, impl=cfg.attn_impl, causal=True,
         kv_repeat=lh // lkv,
     )
+    from ddl_tpu.models import remat as _remat
+
+    attn = _remat.tag_attn_out(attn)  # saveable under remat="selective"
     # Row-sharded wo: each device's head block contributes a PARTIAL
     # output projection; the psum completes the sum over heads.
     x = x + lax.psum(
@@ -678,10 +705,13 @@ def forward_pp(
     mesh: Any,
     n_microbatches: int,
     axis: str = "pp",
+    schedule: str = "gpipe",
+    n_chunks: "int | None" = None,
 ) -> jax.Array:
     """Next-token logits with the transformer blocks pipelined over the
-    mesh's ``axis`` (GPipe microbatch schedule,
-    :func:`ddl_tpu.parallel.pipeline_apply`).
+    mesh's ``axis`` (microbatch schedule per ``schedule`` — gpipe, or
+    the lower-bubble interleaved 1f1b with ``stage_params(...,
+    n_chunks=)`` weights; :func:`ddl_tpu.parallel.pipeline_apply`).
 
     ``params`` is the :func:`stage_params` layout.  Each pipeline stage
     scans its ``L/S`` layers over the residual stream; attention inside a
@@ -731,7 +761,9 @@ def forward_pp(
         def one_layer(x: jax.Array, layer: Params) -> jax.Array:
             return _layer_apply(layer, x, cfg, positions, mesh=None)
 
-    layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
+    from ddl_tpu.models import remat as _remat
+
+    layer_fn = _remat.wrap(one_layer, cfg.remat)
 
     def stage_fn(stage: Params, h: jax.Array) -> jax.Array:
         out, _ = jax.lax.scan(
@@ -744,6 +776,7 @@ def forward_pp(
     x = pipeline_apply(
         params["stages"], x, stage_fn, mesh, n_microbatches, axis=axis,
         stage_param_specs=_TP_STAGE_SPECS if tp_resident else None,
+        schedule=schedule, n_chunks=n_chunks,
     )
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
@@ -756,6 +789,8 @@ def next_token_loss_pp(
     mesh: Any,
     n_microbatches: int,
     axis: str = "pp",
+    schedule: str = "gpipe",
+    n_chunks: "int | None" = None,
 ) -> jax.Array:
     """:func:`next_token_loss` over the pipelined forward — the loss to
     hand :func:`ddl_tpu.parallel.train.make_train_step` (or the Trainer)
@@ -764,6 +799,7 @@ def next_token_loss_pp(
     from ddl_tpu.models.losses import next_token_cross_entropy
 
     logits = forward_pp(
-        params, tokens, cfg, mesh, n_microbatches, axis=axis
+        params, tokens, cfg, mesh, n_microbatches, axis=axis,
+        schedule=schedule, n_chunks=n_chunks,
     )
     return next_token_cross_entropy(logits, tokens)

@@ -58,9 +58,11 @@ class MoeConfig:
     #: Storage dtype of the params pytree (see LlamaConfig.param_dtype —
     #: bf16 halves param+optimizer HBM; expert stacks dominate MoE HBM).
     param_dtype: Any = jnp.float32
-    #: Per-layer jax.checkpoint (see LlamaConfig.remat); the capacity-
-    #: bounded dispatch/combine einsums are the big activations here.
-    remat: bool = False
+    #: Remat policy (none/full/selective/dots, bools for back compat —
+    #: see :attr:`LlamaConfig.remat` / :mod:`ddl_tpu.models.remat`); the
+    #: capacity-bounded dispatch/combine einsums are the big activations
+    #: here, and "selective" keeps the attention outputs saved.
+    remat: Any = False
     attn_impl: str = "auto"
     #: Expert-MLP dispatch implementation.  "einsum": the capacity-
     #: bounded GShard dispatch/combine formulation — fully static, and
@@ -79,6 +81,11 @@ class MoeConfig:
     #: dispatch one-hots dominate (4% MFU at 1.7B) and ragged's N·topk
     #: row duplication exhausts HBM; shard experts over ``ep`` there.
     moe_impl: str = "einsum"
+
+    def __post_init__(self) -> None:
+        from ddl_tpu.models import remat as _remat
+
+        _remat.resolve(self.remat)  # fail on junk at config build time
 
     @property
     def head_dim(self) -> int:
@@ -349,8 +356,17 @@ def _routed_mlp(
                 "w_up": P(None, None, tax),
                 "w_down": P(None, tax, None),
             }
+            # Only the entries the routed MLP reads cross the shard_map
+            # boundary: passing the whole layer dict gathered the UNUSED
+            # attention weights (wq/wk/wv/wo — replicated in_specs) to
+            # every device per layer (advisor r5).  The router + expert
+            # FFN stacks are the entire read set of moe_mlp_ragged.
+            mlp_layer = {
+                k: layer[k]
+                for k in ("w_router", "w_gate", "w_up", "w_down")
+            }
             layer_specs = {
-                k: ff_specs.get(k, P()) for k in layer
+                k: ff_specs.get(k, P()) for k in mlp_layer
             }
 
             def body(hs: jax.Array, lyr: Params):
@@ -369,7 +385,7 @@ def _routed_mlp(
                 in_specs=(P(bax, sax, None), layer_specs),
                 out_specs=(P(bax, sax, None), P()),
                 check_vma=False,
-            )(h, layer)
+            )(h, mlp_layer)
     out, aux = _moe_mlp_dispatch(h.reshape(B * T, -1), layer, cfg)
     return out.reshape(B, T, -1), aux
 
@@ -417,11 +433,12 @@ def forward(
             layer, x, cfg, positions, mesh=mesh, segment_ids=segment_ids
         )
 
-    if cfg.remat:
-        # Save only each layer's residual-stream input; recompute the
-        # routing/dispatch/expert internals in the backward pass (see
-        # LlamaConfig.remat).
-        layer_fn = jax.checkpoint(layer_fn)
+    # Configured remat policy (ddl_tpu.models.remat): "full" recomputes
+    # the routing/dispatch/expert internals in the backward pass;
+    # "selective" additionally keeps the attention outputs saved.
+    from ddl_tpu.models import remat as _remat
+
+    layer_fn = _remat.wrap(layer_fn, cfg.remat)
     for layer in params["layers"]:
         x, aux = layer_fn(x, layer)
         aux_total = aux_total + aux
@@ -434,23 +451,30 @@ def forward(
 # -- pipeline parallelism ----------------------------------------------------
 
 
-def stage_params(params: Params, n_stages: int) -> Params:
+def stage_params(
+    params: Params, n_stages: int, n_chunks: int = 1
+) -> Params:
     """Regroup an :func:`init_params` pytree for pipeline parallelism —
-    the shared ``(S, L/S)`` stage layout
-    (``parallel.pipeline.stack_layer_stages``); embed and head stay
+    the shared ``(S, L/S)`` stage layout (interleaved ``(S, V,
+    L/(S·V))`` when ``n_chunks > 1``, for ``schedule="1f1b"``;
+    ``parallel.pipeline.stack_layer_stages``); embed and head stay
     outside the pipe.  Expert stacks keep their leading E axis inside
-    each stage leaf: ``(S, L/S, E, ...)``."""
+    each stage leaf: ``(S, [V,] L/S, E, ...)``."""
     from ddl_tpu.parallel.pipeline import stack_layer_stages
 
     return {
         "embed": params["embed"],
-        "stages": stack_layer_stages(params["layers"], n_stages),
+        "stages": stack_layer_stages(
+            params["layers"], n_stages, n_chunks=n_chunks
+        ),
         "final_norm": params["final_norm"],
         "lm_head": params["lm_head"],
     }
 
 
-def pp_param_specs(cfg: MoeConfig, axis: str = "pp") -> Params:
+def pp_param_specs(
+    cfg: MoeConfig, axis: str = "pp", n_chunks: int = 1
+) -> Params:
     """PartitionSpecs for the :func:`stage_params` layout — ``pp``
     shards stages; within a stage the expert/Megatron layout of
     :func:`param_specs` applies (``ep`` still shards the expert axis of
@@ -459,7 +483,9 @@ def pp_param_specs(cfg: MoeConfig, axis: str = "pp") -> Params:
 
     return {
         "embed": P(None, "fsdp"),
-        "stages": stage_spec_tree(param_specs(cfg)["layers"][0], axis),
+        "stages": stage_spec_tree(
+            param_specs(cfg)["layers"][0], axis, n_chunks=n_chunks
+        ),
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }
@@ -472,9 +498,12 @@ def forward_pp(
     mesh: Any,
     n_microbatches: int,
     axis: str = "pp",
+    schedule: str = "gpipe",
+    n_chunks: "int | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(logits, mean router aux loss) with the MoE blocks pipelined over
-    ``axis`` (GPipe schedule).
+    ``axis`` (``schedule``: gpipe, or interleaved 1f1b with
+    ``stage_params(..., n_chunks=)`` weights).
 
     The router aux loss accumulates THROUGH the pipe: the activation
     pytree carries a per-row accumulator alongside the residual stream
@@ -519,7 +548,9 @@ def forward_pp(
         h, aux = _layer_apply(layer, h, cfg, positions, mesh=None)
         return h, aux_rows + aux.astype(aux_rows.dtype)
 
-    layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
+    from ddl_tpu.models import remat as _remat
+
+    layer_fn = _remat.wrap(one_layer, cfg.remat)
 
     def stage_fn(stage: Params, state: Any) -> Any:
         out, _ = jax.lax.scan(
@@ -533,6 +564,7 @@ def forward_pp(
         params["stages"],
         (x, jnp.zeros((B,), jnp.float32)),
         stage_fn, mesh, n_microbatches, axis=axis,
+        schedule=schedule, n_chunks=n_chunks,
     )
     x = _llama._rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
@@ -549,12 +581,15 @@ def next_token_loss_pp(
     mesh: Any,
     n_microbatches: int,
     axis: str = "pp",
+    schedule: str = "gpipe",
+    n_chunks: "int | None" = None,
 ) -> jax.Array:
     """Cross-entropy + weighted router aux over the pipelined forward."""
     from ddl_tpu.models.losses import next_token_cross_entropy
 
     logits, aux = forward_pp(
-        params, tokens, cfg, mesh, n_microbatches, axis=axis
+        params, tokens, cfg, mesh, n_microbatches, axis=axis,
+        schedule=schedule, n_chunks=n_chunks,
     )
     ce = next_token_cross_entropy(logits, tokens)
     return ce + cfg.router_aux_weight * aux

@@ -8,7 +8,12 @@ instrumentation, so every pipeline component records into a shared
 
 Well-known name families (each component documents its own; the bench
 JSON contract in ``tools/bench_smoke.py`` pins the load-bearing ones):
-``consumer.*`` / ``ingest.*`` (drain + device feed), ``staging.*`` (the
+``consumer.*`` / ``ingest.*`` (drain + device feed — incl.
+``ingest.release_wait``, forced transfer-completion waits before slot
+release), ``trainer.*`` (``trainer.window_wait`` — the stream loop's
+next-window waits, near zero when H2D overlaps the scans), ``pp.*``
+(``pp.bubble`` / ``pp.chunks`` gauges — the analytic bubble and chunk
+count of the last-compiled pipeline schedule), ``staging.*`` (the
 staged-ingest engine), ``watchdog.*`` / ``integrity.*`` / ``shuffle.*``
 (robustness events), and ``cache.*`` (the shard cache —
 ``cache.hits/misses/evictions/spills/spill_hits/spill_evictions/

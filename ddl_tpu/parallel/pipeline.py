@@ -8,18 +8,30 @@ one SPMD program where every device runs the same loop and the stage index
 selects behaviour with ``where`` masks (compiler-friendly control flow, no
 data-dependent branching).
 
-Schedule (S stages, M microbatches, steps t = 0 .. S+M-2):
+Two schedules behind one ``schedule=`` knob:
 
-- stage 0 feeds microbatch t into the pipe while t < M,
-- every stage applies its layer to the buffer it received,
-- results hop to the next stage between ticks,
-- stage S-1 emits microbatch t-S+1 for t >= S-1; outputs are returned to
-  every device by a masked ``psum`` (valid only on the last stage before
-  it).
+- ``"gpipe"`` (default) — S stages, M microbatches, ticks
+  t = 0 .. S+M-2: stage 0 feeds microbatch t into the pipe while t < M,
+  every stage applies its layer to the buffer it received, results hop
+  to the next stage between ticks, stage S-1 emits microbatch t-S+1 for
+  t >= S-1.  Fill/drain idles ``S-1`` of the ``S+M-1`` ticks:
+  bubble = (S-1)/(M+S-1).
+- ``"1f1b"`` — the interleaved-stage (Megatron "virtual pipeline")
+  schedule: each device hosts ``n_chunks`` NON-ADJACENT stage chunks
+  (device d owns global stages c·S+d), and activations circle the same
+  ``ppermute`` ring ``n_chunks`` times.  Devices reach full occupancy
+  after only ``S-1`` chunk-ticks (each 1/n_chunks the work of a gpipe
+  tick), so bubble = (S-1)/(n_chunks·M + S-1) — 0.273 vs gpipe's 0.429
+  at S=4/M=4/n_chunks=2.  Requires ``M % S == 0`` (microbatch groups
+  must pack the ring seamlessly) and params stacked with
+  ``stack_layer_stages(..., n_chunks=)``.
 
-The whole schedule is differentiable, so ``jax.grad`` through
-``pipeline_apply`` yields the reverse schedule automatically — 1F1B-style
-interleaving is left to XLA's scheduler rather than hand-written.
+Outputs are returned to every device by a masked ``psum`` (valid only on
+the last stage before it).  Both schedules are differentiable, so
+``jax.grad`` through ``pipeline_apply`` yields the reverse schedule
+automatically — the forward/backward 1F1B interleave itself is left to
+XLA's scheduler over the reversed scan; the chunked circular placement
+is what buys the smaller fill/drain bubble.
 
 Stage parameters are user-stacked with a leading S axis sharded
 ``P("pp", ...)`` — at-rest storage holds only each device's own stage
@@ -46,16 +58,52 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
-    """GPipe idle fraction: of the ``S + M - 1`` schedule ticks each
-    stage sees, ``S - 1`` are fill/drain bubble — the ideal against
-    which measured pipeline efficiency is judged (``tools/probe_pp.py``
-    measures the actual ratio; the ``lax.cond`` in the tick body makes
-    bubble ticks cost a branch instead of a layer, so measured should
-    approach this analytic floor from above)."""
+#: Schedules :func:`pipeline_apply` implements (``bubble_fraction``
+#: prices both analytically).
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def _resolve_chunks(schedule: str, n_chunks: "int | None") -> int:
+    """Stage chunks per device for a schedule (gpipe: always 1; 1f1b:
+    caller's ``n_chunks``, default 2 — 1 would be a gpipe relabel)."""
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {schedule!r} (want one of {SCHEDULES})"
+        )
+    if schedule == "gpipe":
+        if n_chunks not in (None, 1):
+            raise ValueError(
+                f"schedule='gpipe' is single-chunk; got n_chunks={n_chunks}"
+                " (use schedule='1f1b' for interleaved stage chunks)"
+            )
+        return 1
+    v = 2 if n_chunks is None else int(n_chunks)
+    if v < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    return v
+
+
+def bubble_fraction(
+    n_stages: int,
+    n_microbatches: int,
+    schedule: str = "gpipe",
+    n_chunks: "int | None" = None,
+) -> float:
+    """Analytic fill/drain idle fraction of a schedule — the ideal
+    against which measured pipeline efficiency is judged
+    (``tools/probe_pp.py`` measures the actual ratio; the ``lax.cond``
+    in the tick body makes bubble ticks cost a branch instead of a
+    layer, so measured should approach this floor from above).
+
+    gpipe: ``(S-1)/(M+S-1)`` — of the ``S+M-1`` ticks each device
+    sees, ``S-1`` are ramp.  1f1b (interleaved, ``v = n_chunks``): the
+    ramp is still ``S-1`` chunk-ticks but each device now works
+    ``v·M`` chunk-ticks, so ``(S-1)/(v·M+S-1)`` — at S=4, M=4, v=2
+    that is 3/11 = 0.273 against gpipe's 3/7 = 0.429."""
     if n_stages < 1 or n_microbatches < 1:
         raise ValueError((n_stages, n_microbatches))
-    return (n_stages - 1) / (n_stages + n_microbatches - 1)
+    v = _resolve_chunks(schedule, n_chunks)
+    return (n_stages - 1) / (v * n_microbatches + n_stages - 1)
 
 
 def stack_stage_params(per_stage: list) -> Any:
@@ -63,37 +111,60 @@ def stack_stage_params(per_stage: list) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
-def stack_layer_stages(layers: list, n_stages: int) -> Any:
-    """Regroup a model's per-layer param list into ``n_stages`` equal
-    stages stacked as ``(S, L/S, ...)`` leaves — the layout
-    :func:`pipeline_apply` schedules, with each stage's ``stage_fn``
-    scanning its own ``L/S`` layers.  Shared by every uniform-block
-    family (llama, vit): one regrouping implementation, not one per
-    model."""
+def stack_layer_stages(
+    layers: list, n_stages: int, n_chunks: int = 1
+) -> Any:
+    """Regroup a model's per-layer param list into the stacked layout
+    :func:`pipeline_apply` schedules.  Shared by every uniform-block
+    family (llama, moe, vit): one regrouping implementation, not one
+    per model.
+
+    ``n_chunks == 1`` (gpipe): ``n_stages`` equal CONSECUTIVE stages
+    stacked as ``(S, L/S, ...)`` leaves, each stage's ``stage_fn``
+    scanning its own ``L/S`` layers.
+
+    ``n_chunks > 1`` (the 1f1b interleaved schedule): ``(S, V, L/(S·V),
+    ...)`` leaves with the Megatron virtual-pipeline assignment —
+    device ``d`` chunk ``c`` holds global stage ``c·S + d``, i.e.
+    NON-ADJACENT layer groups, so activations visit every device once
+    per ring lap."""
     L = len(layers)
-    if n_stages < 1 or L % n_stages:
+    total = n_stages * n_chunks
+    if n_stages < 1 or n_chunks < 1 or L % total:
         raise ValueError(
-            f"n_layers={L} must divide into n_stages={n_stages}"
+            f"n_layers={L} must divide into n_stages={n_stages} x "
+            f"n_chunks={n_chunks}"
         )
-    per = L // n_stages
-    # The (S, L/S) layout IS two applications of stack_stage_params:
-    # layers stack within each stage, then stages stack on top.
+    per = L // total
+
+    def group(s: int) -> Any:
+        return stack_stage_params(layers[s * per : (s + 1) * per])
+
+    if n_chunks == 1:
+        # The (S, L/S) layout IS two applications of stack_stage_params:
+        # layers stack within each stage, then stages stack on top.
+        return stack_stage_params([group(s) for s in range(n_stages)])
     return stack_stage_params(
         [
-            stack_stage_params(layers[s * per : (s + 1) * per])
-            for s in range(n_stages)
+            stack_stage_params(
+                [group(c * n_stages + d) for c in range(n_chunks)]
+            )
+            for d in range(n_stages)
         ]
     )
 
 
-def stage_spec_tree(layer_spec: Any, axis: str = "pp") -> Any:
+def stage_spec_tree(
+    layer_spec: Any, axis: str = "pp", n_chunks: int = 1
+) -> Any:
     """PartitionSpecs for a :func:`stack_layer_stages` stage tree: the
-    ``pp`` axis shards stages, the per-stage layer axis is unsharded,
-    trailing axes keep the model's per-layer layout.  The spec-side
-    twin of :func:`stack_layer_stages` — one transform, not one per
-    model family."""
+    ``pp`` axis shards stages, the chunk (1f1b only) and per-stage
+    layer axes are unsharded, trailing axes keep the model's per-layer
+    layout.  The spec-side twin of :func:`stack_layer_stages` — one
+    transform, not one per model family."""
+    lead = (None,) * (2 if n_chunks > 1 else 1)
     return jax.tree.map(
-        lambda s: P(axis, None, *tuple(s)),
+        lambda s: P(axis, *lead, *tuple(s)),
         layer_spec,
         is_leaf=lambda v: isinstance(v, P),
     )
@@ -112,48 +183,79 @@ def pipeline_spec(inner_spec_tree: Any, axis: str = "pp") -> Any:
 
 
 def _pipeline_shard(params_local: Any, x: Any, *, stage_fn, axis: str,
-                    n_micro: int):
-    """Per-device body (under shard_map over ``axis``).
+                    n_micro: int, n_chunks: int = 1):
+    """Per-device body (under shard_map over ``axis``), both schedules.
 
-    params_local leaves have leading dim 1 (this device's stage) and —
-    with ``stage_param_specs`` — trailing dims still sharded (the
-    stage_fn then owns the collectives over those axes); x is the
-    full (M, mb, ...) microbatched activation PYTREE (a bare array in
-    the common case), replicated over ``axis``.
+    params_local leaves have leading dim 1 (this device's stage; a
+    second ``n_chunks`` dim follows for 1f1b) and — with
+    ``stage_param_specs`` — trailing dims still sharded (the stage_fn
+    then owns the collectives over those axes); x is the full
+    (M, mb, ...) microbatched activation PYTREE (a bare array in the
+    common case), replicated over ``axis``.
+
+    One unified tick body: a microbatch's JOURNEY is ``V·S`` stage
+    hops (device = stage mod S, so every hop is the same +1 ring
+    ``ppermute``, wrapping S-1 → 0 between chunk laps).  At tick ``t``
+    this device's journey offset is ``q = t - d``; the unique live
+    (chunk, microbatch) it hosts is ``c = (q mod V·S) // S`` and
+    ``m = (q // V·S)·S + (q mod S)`` — with V=1 this degenerates to
+    exactly the classic GPipe indexing (c == 0, m == q).
     """
     S = lax.psum(1, axis)
     my_stage = lax.axis_index(axis)
     params_my = jax.tree.map(lambda p: p[0], params_local)
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    V = n_chunks
+    span = V * S  # journey length in ticks (one full set of chunk laps)
 
     def tick(carry, t):
         buf, outputs = carry
-        # Stage 0 ingests microbatch t (clamped once the pipe is draining).
-        feed = jax.tree.map(lambda a: a[jnp.minimum(t, n_micro - 1)], x)
+        q = t - my_stage
+        # This device has real work only for the Mv consecutive ticks
+        # q in [0, M·V) — outside that window (pipe filling/draining)
+        # the buffer is garbage, and running stage_fn on it was pure
+        # bubble FLOPs (VERDICT r2 Weak #5).  A runtime cond skips the
+        # compute: each device evaluates its own scalar predicate, so
+        # fill/drain ticks cost a branch, not a layer.
+        live = (q >= 0) & (q < n_micro * V)
+        qc = jnp.clip(q, 0, n_micro * V - 1)
+        chunk = (qc % span) // S
+        m = (qc // span) * S + (qc % S)
+        # Device 0 ingests microbatch m whenever the arriving journey
+        # position is a chunk-0 stage (global stage 0) — which is also
+        # what discards a finished microbatch wrapping past the last
+        # stage on the 1f1b ring.
+        feed = jax.tree.map(lambda a: a[m], x)
+        ingest = (my_stage == 0) & (chunk == 0)
         inp = jax.tree.map(
-            lambda f, b: jnp.where(my_stage == 0, f, b), feed, buf
+            lambda f, b: jnp.where(ingest, f, b), feed, buf
         )
-        # Stage s holds real data only for ticks s <= t < s + M — outside
-        # that window (pipe filling/draining) the buffer is garbage, and
-        # running stage_fn on it was pure bubble FLOPs (VERDICT r2 Weak
-        # #5).  A runtime cond skips the compute: each device evaluates its
-        # own scalar predicate, so fill/drain ticks cost a branch, not a
-        # layer.
-        live = (t >= my_stage) & (t < my_stage + n_micro)
+        if V == 1:
+            params_tick = params_my
+        else:
+            # The live chunk's weights: a dynamic slice of the local
+            # (V, L/(S·V), ...) stack — differentiable (gather fwd,
+            # scatter-add in the reverse schedule).
+            params_tick = jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(
+                    p, chunk, 0, keepdims=False
+                ),
+                params_my,
+            )
         y = lax.cond(
             live,
-            lambda a: stage_fn(params_my, a),
+            lambda a: stage_fn(params_tick, a),
             lambda a: jax.tree.map(jnp.zeros_like, a),
             inp,
         )
-        # Last stage emits microbatch t-S+1 once the pipe is full.
-        out_idx = t - (S - 1)
-        valid = (my_stage == S - 1) & (out_idx >= 0)
+        # The last device emits microbatch m after its final chunk
+        # (global stage V·S - 1).
+        valid = live & (my_stage == S - 1) & (chunk == V - 1)
         outputs = lax.cond(
             valid,
             lambda o: jax.tree.map(
                 lambda acc, v: lax.dynamic_update_index_in_dim(
-                    acc, v, jnp.maximum(out_idx, 0), 0
+                    acc, v, m, 0
                 ),
                 o, y,
             ),
@@ -170,7 +272,7 @@ def _pipeline_shard(params_local: Any, x: Any, *, stage_fn, axis: str,
         lambda a: jnp.zeros((n_micro,) + a.shape[1:], a.dtype), x
     )
     (_, outputs), _ = lax.scan(
-        tick, (buf0, out0), jnp.arange(n_micro + S - 1)
+        tick, (buf0, out0), jnp.arange(n_micro * V + S - 1)
     )
     # Outputs are populated only on the last stage; psum broadcasts them.
     return jax.tree.map(
@@ -190,9 +292,21 @@ def pipeline_apply(
     axis: str = "pp",
     batch_spec: "P | None" = None,
     stage_param_specs: Any = None,
+    schedule: str = "gpipe",
+    n_chunks: "int | None" = None,
 ) -> Any:
     """Apply S pipelined stages to a batch x (B, ...).
 
+    - ``schedule``: ``"gpipe"`` (default) or ``"1f1b"`` — the
+      interleaved-stage schedule with ``n_chunks`` (default 2) stage
+      chunks per device, cutting the fill/drain bubble from
+      ``(S-1)/(M+S-1)`` to ``(S-1)/(n_chunks·M+S-1)`` (see
+      :func:`bubble_fraction`).  1f1b requires ``n_microbatches % S ==
+      0`` and params stacked via ``stack_layer_stages(...,
+      n_chunks=)`` (leaves ``(S, n_chunks, L/(S·n_chunks), ...)``);
+      outputs and gradients are bit-for-bit the same function as gpipe
+      at identical (S·n_chunks total stages, M) — only the device
+      placement and tick order change.
     - ``stacked_params``: stage params stacked on a leading S axis (see
       :func:`stack_stage_params`), sharded ``P(axis, ...)``.
     - ``stage_fn(stage_params, x) -> y`` with y structurally identical
@@ -221,9 +335,36 @@ def pipeline_apply(
     params/(S·tp).  Default (None): trailing axes gather at the
     boundary, ``stage_fn`` is a plain local function.
     """
+    V = _resolve_chunks(schedule, n_chunks)
     S = jax.tree.leaves(stacked_params)[0].shape[0]
     B = jax.tree.leaves(x)[0].shape[0]
     assert B % n_microbatches == 0, (B, n_microbatches)
+    if V > 1:
+        if n_microbatches % S:
+            raise ValueError(
+                f"schedule='1f1b' needs n_microbatches ({n_microbatches}) "
+                f"divisible by n_stages ({S}): microbatch groups of S "
+                "pack the chunk laps seamlessly"
+            )
+        bad = [
+            leaf.shape
+            for leaf in jax.tree.leaves(stacked_params)
+            if leaf.ndim < 2 or leaf.shape[1] != V
+        ]
+        if bad:
+            raise ValueError(
+                f"schedule='1f1b' with n_chunks={V} expects stage leaves "
+                f"shaped (S, {V}, ...) — stack with "
+                f"stack_layer_stages(layers, n_stages, n_chunks={V}); got "
+                f"leading shapes {bad[:3]}"
+            )
+        # NB this check is necessary, not sufficient: a gpipe stack with
+        # L/S == n_chunks is shape-INDISTINGUISHABLE from a chunked one
+        # (its layer axis would be misread as the chunk axis and layers
+        # would apply in the wrong global order).  The layout contract —
+        # stack with the same n_chunks you schedule with — is the
+        # caller's; the model-side stage_params(n_chunks=) helpers keep
+        # the two knobs adjacent for exactly this reason.
     mb = B // n_microbatches
     if batch_spec is None:
         batch_spec = (
@@ -251,9 +392,23 @@ def pipeline_apply(
         # batch-coupled stages (MoE routing capacity/slot competition)
         # must see the same token groups on every mesh shape, or runs
         # would not be comparable between a pp mesh and the fallback.
+        # The 1f1b chunk layout flattens back to global stage order
+        # (stage c·S+d lives at [d, c], so (S, V) transposes to (V, S)
+        # before the merge).
+        seq_params = (
+            stacked_params
+            if V == 1
+            else jax.tree.map(
+                lambda a: jnp.swapaxes(a, 0, 1).reshape(
+                    (a.shape[0] * a.shape[1],) + a.shape[2:]
+                ),
+                stacked_params,
+            )
+        )
+
         def run_stages(state):
             out, _ = lax.scan(
-                lambda h, p: (stage_fn(p, h), None), state, stacked_params
+                lambda h, p: (stage_fn(p, h), None), state, seq_params
             )
             return out
 
@@ -266,12 +421,23 @@ def pipeline_apply(
     )
 
     from ddl_tpu._compat import shard_map
+    from ddl_tpu.observability import metrics as _default_metrics
+
+    # Schedule observability (trace-time, once per compile): the
+    # analytic bubble of the schedule that actually lowered, surfaced
+    # through north_star_report / the bench JSON as pp.* gauges.
+    _default_metrics().set_gauge(
+        "pp.bubble",
+        bubble_fraction(S, n_microbatches, schedule=schedule, n_chunks=V),
+    )
+    _default_metrics().set_gauge("pp.chunks", float(V))
 
     if stage_param_specs is None:
         param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
     else:
+        chunk_lead = (None,) if V > 1 else ()
         param_specs = jax.tree.map(
-            lambda s: P(axis, *tuple(s)),
+            lambda s: P(axis, *chunk_lead, *tuple(s)),
             stage_param_specs,
             is_leaf=lambda v: isinstance(v, P),
         )
@@ -281,7 +447,7 @@ def pipeline_apply(
     fn = shard_map(
         functools.partial(
             _pipeline_shard, stage_fn=stage_fn, axis=axis,
-            n_micro=n_microbatches,
+            n_micro=n_microbatches, n_chunks=V,
         ),
         mesh=mesh,
         in_specs=(param_specs, batch_specs),

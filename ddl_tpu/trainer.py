@@ -51,7 +51,15 @@ def _stream_splits(loader: Any) -> Tuple[int, ...]:
 def _window_cols(win: Any, col_splits: Sequence[int]) -> Tuple[Any, ...]:
     """Split a (bpw, batch, *features) device window into column arrays
     along the FIRST feature axis — the axis every batch-path split uses
-    (``dataloader._split_columns`` slices ``batch[:, off:off+w]``)."""
+    (``dataloader._split_columns`` slices ``batch[:, off:off+w]``).
+
+    A single full-width column (token windows, ``splits=(seq,)``) passes
+    through UNSLICED: the identity slice was a per-window device op
+    whose output also lost the window's NamedSharding, forcing the
+    multistep's ``_reshard`` into a second device_put — two dispatches
+    per window for nothing, squarely on the stream-fit hot path."""
+    if len(col_splits) == 1 and col_splits[0] == win.shape[2]:
+        return (win,)
     cols, off = [], 0
     for w in col_splits:
         cols.append(win[:, :, off : off + w])
@@ -85,12 +93,29 @@ class Trainer:
         watchdog_respawn: bool = False,
         stall_budget_s: float = 300.0,
         metrics: Optional[Metrics] = None,
-        accum_steps: int = 1,
+        accum_steps: Optional[int] = None,
+        train_config: Any = None,
     ):
         """``loss_fn(params, batch) -> scalar`` over the loader's batch
         tuple; ``init_params`` is the initial params pytree (ignored when a
-        checkpoint exists in ``checkpoint_dir``)."""
+        checkpoint exists in ``checkpoint_dir``).
+
+        ``train_config`` (a :class:`ddl_tpu.config.TrainConfig`)
+        supplies the training hot-path defaults — today that is
+        ``accum_steps`` (an explicit argument wins; the default is the
+        ``None`` sentinel precisely so an explicit ``accum_steps=1``
+        can DISABLE accumulation against a config that asks for it);
+        its remat policy and pipeline schedule apply where the model is
+        BUILT (``train_config.model_config(cfg)`` /
+        ``train_config.pipeline_kwargs()``), since the Trainer only
+        ever sees the closed-over ``loss_fn``."""
         from ddl_tpu.parallel.train import make_train_step
+
+        if accum_steps is None:
+            accum_steps = (
+                train_config.accum_steps if train_config is not None else 1
+            )
+        self.train_config = train_config
 
         self.mesh = mesh
         self.checkpoint_dir = checkpoint_dir
@@ -310,7 +335,17 @@ class Trainer:
 
         pending = None
         epoch = start_epoch
-        for win in loader.windows(lookahead=stream_lookahead):
+        stream = loader.windows(lookahead=stream_lookahead)
+        _done = object()
+        while True:
+            # Window-wait accounting: with healthy overlap the next
+            # window is already in flight while the previous scan runs,
+            # so this wait stays near zero; it flows into
+            # north_star_report["window_wait_s"] and the bench JSON.
+            with self.metrics.timed("trainer.window_wait"):
+                win = next(stream, _done)
+            if win is _done:
+                break
             if window_hook is not None:
                 win = window_hook(win)
             state, losses = multi_for(win.shape[0])(

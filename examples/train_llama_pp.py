@@ -13,6 +13,10 @@ Run:
 
     python examples/train_llama_pp.py            # pp=2 × dp over the rest
     python examples/train_llama_pp.py pp_tp      # pp=2 × tp=2 × dp (8 devices)
+    python examples/train_llama_pp.py pp_1f1b    # interleaved 1F1B schedule
+                                                 # (2 chunks/device: bubble
+                                                 # 0.111 vs gpipe's 0.2 at
+                                                 # pp=2, M=4)
 
 Exit 0 with finite, decreasing loss is the pass criterion.
 """
@@ -48,7 +52,7 @@ def main(layout: str = "pp") -> int:
     import optax
     from jax.sharding import PartitionSpec as P
 
-    from ddl_tpu.config import LoaderConfig
+    from ddl_tpu.config import LoaderConfig, TrainConfig
     from ddl_tpu.models import llama
     from ddl_tpu.parallel import bubble_fraction
     from ddl_tpu.parallel.mesh import make_mesh
@@ -61,6 +65,14 @@ def main(layout: str = "pp") -> int:
 
     n_dev = len(jax.devices())
     n_micro = 4
+    # The training hot-path knobs ride TrainConfig (env-overridable as
+    # DDL_TPU_TRAIN_*): the pp_1f1b layout selects the interleaved
+    # schedule, everything else stays gpipe.
+    tc = TrainConfig(
+        schedule="1f1b" if layout == "pp_1f1b" else "gpipe",
+        pp_chunks=2 if layout == "pp_1f1b" else 0,
+        n_microbatches=n_micro,
+    )
     if layout == "pp_tp":
         if n_dev % 4:
             raise SystemExit(f"pp_tp needs a multiple of 4 devices, have {n_dev}")
@@ -70,8 +82,9 @@ def main(layout: str = "pp") -> int:
             raise SystemExit(f"pp needs an even device count, have {n_dev}")
         axes = {"pp": 2, "dp": n_dev // 2}
     mesh = make_mesh(axes)
-    print(f"mesh {axes}, {n_micro} microbatches, "
-          f"bubble={bubble_fraction(axes['pp'], n_micro):.3f}")
+    n_chunks = tc.pp_chunks or 1
+    print(f"mesh {axes}, {n_micro} microbatches, schedule={tc.schedule}, "
+          f"bubble={bubble_fraction(axes['pp'], n_micro, schedule=tc.schedule, n_chunks=tc.pp_chunks or None):.3f}")
 
     model = llama.LlamaConfig(
         vocab=VOCAB, d_model=128, n_layers=4, n_heads=4, n_kv_heads=2,
@@ -88,15 +101,18 @@ def main(layout: str = "pp") -> int:
     )
     trainer = Trainer(
         loss_fn=lambda p, b: llama.next_token_loss_pp(
-            p, b[0], model, mesh, n_microbatches=n_micro
+            p, b[0], model, mesh, n_microbatches=n_micro,
+            **tc.pipeline_kwargs(),
         ),
         optimizer=optax.adamw(3e-3),
         mesh=mesh,
-        param_specs=llama.pp_param_specs(model),
+        param_specs=llama.pp_param_specs(model, n_chunks=n_chunks),
         init_params=llama.stage_params(
-            llama.init_params(model, jax.random.key(0)), axes["pp"]
+            llama.init_params(model, jax.random.key(0)), axes["pp"],
+            n_chunks=n_chunks,
         ),
         batch_spec=P(("dp",)),
+        train_config=tc,
     )
     result = trainer.fit(
         TokenStreamProducer(token_file, SEQ_LEN, WINDOW_ROWS),

@@ -59,6 +59,16 @@ def test_train_llama_pp_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_llama_pp_1f1b_example(tmp_path):
+    """The interleaved 1F1B layout of the pp example: TrainConfig-driven
+    schedule selection, chunked stage params, lower analytic bubble."""
+    out = _run("train_llama_pp.py", "pp_1f1b")
+    assert "OK" in out
+    assert "schedule=1f1b" in out
+    assert "bubble=0.111" in out
+
+
+@pytest.mark.slow
 def test_train_vit_example(tmp_path):
     out = _run("train_vit.py")
     assert "PASS" in out

@@ -146,6 +146,15 @@ VIOLATIONS = {
                 _shard_cache[path] = _load(path)   # append-only memo
             return _shard_cache[path]
     """,
+    "DDL014": """
+        import jax
+
+        def forward(params, x, layers):
+            layer_fn = jax.checkpoint(_layer)   # silent full recompute
+            for layer in layers:
+                x = layer_fn(x, layer)
+            return x
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -283,6 +292,20 @@ CLEAN = {
 
             def reset(self):
                 self._counts.clear()   # reset site: bounded
+    """,
+    "DDL014": """
+        import jax
+
+        def forward(params, x, layers):
+            layer_fn = jax.checkpoint(
+                _layer, policy=jax.checkpoint_policies.nothing_saveable
+            )   # the default, SPELLED OUT
+            for layer in layers:
+                x = layer_fn(x, layer)
+            return x
+
+        def load_state(path):
+            return jax.checkpoint.restore(path)  # not the remat transform
     """,
 }
 

@@ -711,6 +711,146 @@ class TestLlama3_8BScale:
         assert "stablehlo.dot_general" in text
 
 
+class TestRematPolicies:
+    """Named remat policies (ddl_tpu.models.remat): every policy is a
+    pure memory/FLOPs trade — loss and grads must match the no-remat
+    path exactly (the ISSUE 5 selective-remat equivalence test)."""
+
+    def _cfg(self, **kw):
+        base = dict(
+            vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, dtype=jnp.float32, attn_impl="dense",
+        )
+        base.update(kw)
+        return llama.LlamaConfig(**base)
+
+    def test_resolve_names_and_bools(self):
+        from ddl_tpu.models import remat
+
+        assert remat.resolve(False) == "none"
+        assert remat.resolve(None) == "none"
+        assert remat.resolve(True) == "full"
+        for name in remat.POLICIES:
+            assert remat.resolve(name) == name
+        with pytest.raises(ValueError):
+            remat.resolve("everything")
+        with pytest.raises(ValueError):
+            self._cfg(remat="everything")  # config validates at build
+
+    @pytest.mark.parametrize("policy", ["full", "selective", "dots"])
+    def test_llama_loss_and_grads_match_no_remat(self, policy):
+        cfg = self._cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab, (2, 16)),
+            jnp.int32,
+        )
+        ln, gn = jax.value_and_grad(
+            lambda p: llama.next_token_loss(p, tokens, cfg)
+        )(params)
+        lr, gr = jax.value_and_grad(
+            lambda p: llama.next_token_loss(
+                p, tokens, self._cfg(remat=policy)
+            )
+        )(params)
+        np.testing.assert_allclose(float(ln), float(lr), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(gn), jax.tree.leaves(gr)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            )
+
+    def test_selective_saves_attention_outputs(self):
+        """The attention-output tag must be LIVE in the traced forward:
+        with the name stripped (or the tag site dropped), "selective"
+        would silently degrade to "full" and re-run the attention
+        kernel in every backward pass."""
+        from ddl_tpu.models import remat
+
+        cfg = self._cfg(remat="selective")
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        tagged = jax.make_jaxpr(
+            lambda p: llama.forward(p, tokens, cfg)
+        )(params)
+        assert remat.ATTN_OUT_NAME in str(tagged)
+
+    def test_moe_selective_matches_no_remat(self):
+        from ddl_tpu.models import moe
+
+        base = dict(
+            vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, n_experts=4, dtype=jnp.float32, attn_impl="dense",
+            capacity_factor=8.0,
+        )
+        cfg = moe.MoeConfig(**base)
+        cfg_r = moe.MoeConfig(**base, remat="selective")
+        params = moe.init_params(cfg, jax.random.key(0))
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab, (2, 16)),
+            jnp.int32,
+        )
+        ln, gn = jax.value_and_grad(
+            lambda p: moe.next_token_loss(p, tokens, cfg)
+        )(params)
+        lr, gr = jax.value_and_grad(
+            lambda p: moe.next_token_loss(p, tokens, cfg_r)
+        )(params)
+        np.testing.assert_allclose(float(ln), float(lr), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(gn), jax.tree.leaves(gr)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            )
+
+
+class TestMixtral8x7bScale:
+    """The pod-scale MoE config (VERDICT r5 weak #8): the sharded MoE
+    train step must trace and lower at Mixtral-8x7B scale on an
+    fsdp x tp mesh — mirroring llama's 8B lowering test.  Lowering (not
+    compiling) validates shapes, shardings, and GSPMD constraints
+    without materialising the 47B-parameter pytree."""
+
+    @pytest.mark.slow
+    def test_mixtral_train_step_lowers_on_fsdp_tp_mesh(self):
+        import optax
+
+        from ddl_tpu.models import moe
+        from ddl_tpu.parallel.train import _named, _prune_indivisible
+
+        cfg = moe.MoeConfig.mixtral_8x7b()
+        mesh = make_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+        opt = optax.adamw(1e-4)
+
+        params_shape = jax.eval_shape(
+            lambda: moe.init_params(cfg, jax.random.key(0))
+        )
+        opt_state_shape = jax.eval_shape(opt.init, params_shape)
+        batch = jax.ShapeDtypeStruct((2, 8192), jnp.int32)
+
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: moe.next_token_loss(p, tokens, cfg, mesh)
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        param_sh = jax.tree.map(
+            _prune_indivisible,
+            _named(mesh, moe.param_specs(cfg)),
+            params_shape,
+        )
+        lowered = jax.jit(
+            step, in_shardings=(param_sh, None, None)
+        ).lower(params_shape, opt_state_shape, batch)
+        text = lowered.as_text()
+        # Mixtral's params really are in the traced program: its vocab
+        # (32000) and per-expert hidden (14336) appear, with real
+        # matmuls.
+        assert "32000" in text
+        assert "14336" in text
+        assert "stablehlo.dot_general" in text
+
+
 class TestViT:
     """Vision transformer: the image-pipeline model family."""
 

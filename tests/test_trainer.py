@@ -526,3 +526,22 @@ def test_evaluate_metric_pass(rng, tmp_path):
         window_stream=True,
     )
     assert abs(acc_win - acc) < 1e-6, (acc_win, acc)
+
+
+def test_fit_window_stream_records_window_wait(rng):
+    """The stream loop's next-window waits flow into the metrics
+    registry (trainer.window_wait -> north_star_report window_wait_s):
+    the overlap-health observable ISSUE 5 added to the bench JSON."""
+    from ddl_tpu.ingest import north_star_report
+
+    _, trainer = _make_trainer()
+    res = trainer.fit(
+        _producer(rng), batch_size=16, n_epochs=3, n_producers=2,
+        mode="thread", output="jax", window_stream=True,
+    )
+    t = res.metrics.timer("trainer.window_wait")
+    # One wait span per window plus the end-of-stream probe.
+    assert t.count >= 4, t
+    report = north_star_report(res.metrics)
+    assert report["window_wait_s"] == t.total_s
+    assert "release_wait_s" in report and "pp_bubble" in report

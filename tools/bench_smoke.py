@@ -1,13 +1,24 @@
-"""bench-smoke: run the ingest bench at tiny CPU geometry and validate
-its JSON contract.
+"""bench-smoke: run the bench at tiny CPU geometry and validate its
+JSON contract.
 
 CI-grade guard for the bench itself (`make bench-smoke` / `make check`):
 the full bench is too slow for per-PR runs, but its JSON line is an
 interface — round 2 shipped a bench whose output silently lost fields.
-This runs `DDL_BENCH_MODE=ingest` with a small window/batch geometry,
-asserts the last stdout line parses as JSON, and asserts the staged-
-ingest extras (`staging.stage_copy_s` etc.) plus the staged-vs-inline
-pair are present.
+Two passes:
+
+1. `DDL_BENCH_MODE=ingest` with a small window/batch geometry — the
+   last stdout line must parse as JSON and carry the staged-ingest
+   extras (`staging.stage_copy_s` etc.), the staged-vs-inline pair,
+   the robustness/cache blocks, and the `headline_config` label (the
+   bench must never headline a config it measured as slower).
+2. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
+   overlap-health keys (`window_wait_s`, `release_wait_s`,
+   schedule/bubble gauges) and its `pipeline_overhead` against the
+   matched no-loader ceiling must be <= PIPELINE_OVERHEAD_MAX.  The
+   overhead gate retries once: the 2-core box's one-sided noise
+   occasionally inflates a single run by more than the gate margin,
+   while the regression this gate exists to catch (the per-window
+   blocking sync, r5) measured 0.10-0.12 on EVERY run.
 
 Exit 0 on success; nonzero with a reason on any violation.
 """
@@ -22,7 +33,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: Keys the ingest headline must always carry.
-REQUIRED = ("metric", "value", "unit", "platform")
+REQUIRED = ("metric", "value", "unit", "platform", "headline_config")
+#: fit_stream contract (ISSUE 5): throughput + matched ceiling +
+#: overlap-health counters + schedule gauges.
+REQUIRED_FIT = (
+    "tokens_per_sec", "ceiling_tokens_per_sec", "pipeline_overhead",
+    "window_wait_s", "release_wait_s", "schedule", "pp_bubble",
+)
+#: Stream-fit overhead ceiling vs the matched no-loader scan (CPU).
+PIPELINE_OVERHEAD_MAX = 0.02
+#: Overhead-gate attempts (key presence is never retried).
+FIT_ATTEMPTS = 2
 #: Staged-engine extras (north_star_report staging block).
 REQUIRED_STAGING = (
     "stage_copy_s", "transfer_s", "stall_s",
@@ -47,11 +68,11 @@ REQUIRED_CACHE = (
 MIN_WARM_VS_COLD = 2.0
 
 
-def main() -> int:
+def _run_bench(mode: str) -> "dict | None":
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.setdefault("DDL_BENCH_PLATFORM", "cpu")
-    env["DDL_BENCH_MODE"] = "ingest"
+    env["DDL_BENCH_MODE"] = mode
     # Tiny geometry: ~0.5 MiB windows, a few epochs — finishes in ~1 min
     # on one core while still spanning producers -> rings -> device.
     env.setdefault("DDL_BENCH_NDATA", "512")
@@ -67,12 +88,21 @@ def main() -> int:
     if proc.returncode != 0 or not lines:
         print(proc.stdout)
         print(proc.stderr, file=sys.stderr)
-        print(f"bench-smoke: bench exited rc={proc.returncode}")
-        return 1
+        print(f"bench-smoke: bench ({mode}) exited rc={proc.returncode}")
+        return None
     try:
-        result = json.loads(lines[-1])
+        return json.loads(lines[-1])
     except json.JSONDecodeError as e:
-        print(f"bench-smoke: last line is not JSON ({e}): {lines[-1]!r}")
+        print(
+            f"bench-smoke: last {mode} line is not JSON ({e}): "
+            f"{lines[-1]!r}"
+        )
+        return None
+
+
+def main() -> int:
+    result = _run_bench("ingest")
+    if result is None:
         return 1
 
     missing = [k for k in REQUIRED if k not in result]
@@ -125,14 +155,54 @@ def main() -> int:
                 "over the throttled backend"
             )
             return 1
+    # -- pass 2: the training hot path (ISSUE 5) -----------------------
+    overheads = []
+    for attempt in range(1, FIT_ATTEMPTS + 1):
+        train = _run_bench("train")
+        if train is None:
+            return 1
+        fit = train.get("fit_stream")
+        if not isinstance(fit, dict):
+            print(json.dumps(train, indent=1))
+            print(
+                "bench-smoke: no fit_stream block "
+                f"(errors={train.get('errors')})"
+            )
+            return 1
+        fit_missing = [k for k in REQUIRED_FIT if k not in fit]
+        if fit_missing:
+            print(json.dumps(fit, indent=1))
+            print(f"bench-smoke: fit_stream missing keys: {fit_missing}")
+            return 1
+        overheads.append(fit["pipeline_overhead"])
+        if fit["pipeline_overhead"] <= PIPELINE_OVERHEAD_MAX:
+            break
+        if attempt < FIT_ATTEMPTS:
+            print(
+                "bench-smoke: fit_stream.pipeline_overhead "
+                f"{fit['pipeline_overhead']} > {PIPELINE_OVERHEAD_MAX}; "
+                "retrying once (one-sided box noise)"
+            )
+    if min(overheads) > PIPELINE_OVERHEAD_MAX:
+        print(json.dumps(fit, indent=1))
+        print(
+            "bench-smoke: fit_stream.pipeline_overhead "
+            f"{overheads} > {PIPELINE_OVERHEAD_MAX} in every attempt — "
+            "the window stream is not overlap-correct"
+        )
+        return 1
+
     staged = result["value"]
     inline = result.get("ingest_inline", {}).get("samples_per_sec")
     print(
-        "bench-smoke: OK — staged "
-        f"{staged} vs inline {inline} samples/s; staging + robustness "
-        "extras present; cache warm/cold "
+        "bench-smoke: OK — headline "
+        f"{result.get('headline_config')} {staged} vs inline {inline} "
+        "samples/s; staging + robustness extras present; cache "
+        f"warm/cold "
         f"{cache.get('warm_vs_cold') if isinstance(cache, dict) else '?'}x "
-        "byte-identical"
+        "byte-identical; fit_stream overhead "
+        f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "
+        f"(window_wait_s={fit['window_wait_s']})"
     )
     return 0
 

@@ -207,6 +207,43 @@ class ConstantKeyInLoop(LoopDepthChecker):
 
 
 @register
+class CheckpointWithoutPolicy(Checker):
+    """DDL014: every ``jax.checkpoint`` / ``jax.remat`` names a policy.
+
+    A bare ``jax.checkpoint(fn)`` silently means "recompute everything"
+    — including the attention kernel, the most expensive op in a layer
+    (the 1.39B bench config lost 7 MFU points to exactly this, VERDICT
+    r5 weak #3).  Model code must state the trade explicitly:
+    ``policy=jax.checkpoint_policies...`` (``nothing_saveable`` IS the
+    default, spelled out), or go through the shared
+    ``ddl_tpu.models.remat.wrap`` helper, which always does.
+    """
+
+    code = "DDL014"
+    summary = "jax.checkpoint/jax.remat without an explicit policy="
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func) or ""
+        seg = dotted.rsplit(".", 1)[-1]
+        if (
+            seg in ("checkpoint", "remat")
+            and (
+                dotted.startswith("jax.")
+                or dotted.startswith("ad_checkpoint.")
+            )
+            and not any(kw.arg == "policy" for kw in node.keywords)
+        ):
+            self.report(
+                node,
+                f"{dotted}(...) without policy= recomputes EVERYTHING "
+                "in the backward pass; name the trade explicitly "
+                "(policy=jax.checkpoint_policies...) or use "
+                "ddl_tpu.models.remat.wrap",
+            )
+        self.generic_visit(node)
+
+
+@register
 class JitInLoop(LoopDepthChecker):
     """DDL010: no ``jax.jit`` construction inside a loop body.
 

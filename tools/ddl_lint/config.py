@@ -38,6 +38,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL011",  # fresh staging copy/allocation in an ingest hot path
     "DDL012",  # unbounded blocking wait (no timeout) on a framework path
     "DDL013",  # unbounded module/instance-level dict cache (no eviction)
+    "DDL014",  # jax.checkpoint/remat without an explicit policy
 )
 
 

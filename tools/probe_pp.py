@@ -48,6 +48,10 @@ def main(n_devices: int = 8, d_model: int = 128, ms=(1, 2, 4, 8, 16)) -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     S, mb = 4, 4
+    # DDL_PROBE_SCHEDULE=1f1b probes the interleaved schedule (V=2
+    # chunks/device; M must stay a multiple of S — the sweep below is).
+    schedule = os.environ.get("DDL_PROBE_SCHEDULE", "gpipe")
+    n_chunks = 2 if schedule == "1f1b" else 1
     # bf16 is EMULATED (slow) on the CPU sim — probe the schedule there
     # in fp32 at a shorter sequence; absolute times only matter on chip.
     T = 128 if on_tpu else 32
@@ -57,7 +61,7 @@ def main(n_devices: int = 8, d_model: int = 128, ms=(1, 2, 4, 8, 16)) -> None:
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
     pp_params = llama.stage_params(
-        llama.init_params(cfg, jax.random.key(0)), S
+        llama.init_params(cfg, jax.random.key(0)), S, n_chunks=n_chunks
     )
     devices = jax.devices()[:n_devices]
     mesh = make_mesh({"pp": S, "dp": n_devices // S}, devices)
@@ -74,8 +78,11 @@ def main(n_devices: int = 8, d_model: int = 128, ms=(1, 2, 4, 8, 16)) -> None:
 
     print(f"S={S} stages, {cfg.n_layers} layers, d_model={d_model}, "
           f"mb={mb}, seq={T}, {n_devices} devices "
-          f"({jax.default_backend()})")
+          f"({jax.default_backend()}), schedule={schedule}")
     ms = tuple(sorted(set(ms)))
+    if schedule == "1f1b":
+        # 1f1b needs M % S == 0; round the sweep up to S multiples.
+        ms = tuple(sorted({max(S, (M + S - 1) // S * S) for M in ms}))
     assert len(ms) >= 2, "need >= 2 sweep points for the marginal slope"
     times = {}
     for M in ms:
@@ -84,7 +91,9 @@ def main(n_devices: int = 8, d_model: int = 128, ms=(1, 2, 4, 8, 16)) -> None:
         )
         grad_pp = jax.jit(jax.grad(
             lambda p, t, _M=M: llama.next_token_loss_pp(
-                p, t, cfg, mesh, n_microbatches=_M
+                p, t, cfg, mesh, n_microbatches=_M,
+                schedule=schedule,
+                n_chunks=n_chunks if schedule == "1f1b" else None,
             )
         ))
         times[M] = timed(grad_pp, pp_params, tokens)
@@ -94,11 +103,14 @@ def main(n_devices: int = 8, d_model: int = 128, ms=(1, 2, 4, 8, 16)) -> None:
     print(f"per-tick (marginal microbatch) cost: {slope * 1e3:.2f} ms")
     for M in ms:
         eff = slope * M / times[M] if times[M] > 0 else float("nan")
-        ideal = 1.0 - bubble_fraction(S, M)
+        bub = bubble_fraction(
+            S, M, schedule=schedule,
+            n_chunks=n_chunks if schedule == "1f1b" else None,
+        )
         print(
             f"M={M:3d}  t={times[M] * 1e3:8.1f} ms"
-            f"  measured_eff={eff:6.3f}  ideal={ideal:.3f}"
-            f"  bubble={bubble_fraction(S, M):.3f}"
+            f"  measured_eff={eff:6.3f}  ideal={1.0 - bub:.3f}"
+            f"  bubble={bub:.3f}"
         )
 
 
