@@ -449,13 +449,24 @@ class DistributedDataLoader:
             # down the sanctioned inline path.
             if engine is not None and not engine.faulted:
                 ingestor = self._ingestor
+                # Shm-backed staging (write-once pipeline): on clients
+                # whose device_put genuinely copies, the staged transfer
+                # sources the slot DIRECTLY — no slot→staging memcpy —
+                # and copy_done (the release edge) fires at transfer
+                # completion.  The slot is held for the DMA, so the
+                # early-release torn-read hazard the staged CRC re-check
+                # guards does not exist on this path.
+                alias = (
+                    ingestor.stream_alias
+                    and not engine.executor.alias_unsafe
+                )
                 # Post-copy re-verify (ddl_tpu.integrity): when the
                 # served rows span the whole payload, the committed CRC
                 # also certifies the staging copy — the executor checks
                 # it after its slot→buffer memcpy, catching a producer
                 # overwriting a not-yet-copied slot.
                 expected_crc = None
-                if self._integrity and window.nbytes == int(
+                if not alias and self._integrity and window.nbytes == int(
                     ring.slot_payload(slot)
                 ):
                     expected_crc = integrity.read_header(
@@ -465,6 +476,7 @@ class DistributedDataLoader:
                     window,
                     lambda buf: (ingestor._transfer(buf),) * 2,
                     expected_crc=expected_crc,
+                    alias_src=alias,
                 )
             else:
                 payload = self._ingestor.put_window(

@@ -12,7 +12,12 @@ TPU-native differences:
   refills while the consumer drains — the double-buffering the reference
   sketched but never built (reference ``ddl/mpi_dataloader.py:21-28``).
   Producer functions with ``inplace_fill = True`` skip the private array
-  and write straight into ring slots (zero-copy fill).
+  and write straight into ring slots (zero-copy fill); functions
+  advertising ``supports_inplace_fill`` get the same slot view whenever
+  no global shuffle needs a persistent ``my_ary`` and ``DDL_TPU_INPLACE``
+  allows (write-once producers — acquire before fill, integrity trailer
+  stamped strictly AFTER the fill, so a mid-fill crash can never commit
+  a torn slot).
 - The callback chain actually runs every callback (SURVEY Q1 fixed), so a
   registered global shuffler really executes.
 - Shutdown arrives as :class:`ShutdownRequested` out of any blocked ring
@@ -48,6 +53,18 @@ logger = logging.getLogger("ddl_tpu")
 #: Default ring depth. 2 = double buffering; 1 = reference-style strict
 #: alternation (one window per producer, consumer and producer ping-pong).
 DEFAULT_NSLOTS = 2
+
+
+def inplace_enabled(override: bool = None) -> bool:
+    """The ``DDL_TPU_INPLACE`` gate (default ON): lets producers that
+    advertise ``supports_inplace_fill`` write straight into ring slots.
+    ``0`` is the escape hatch back to the private-array + commit-memcpy
+    fill (debugging, byte-identity A/B) — it never affects producers
+    that FORCE ``inplace_fill = True`` (that is their contract, not a
+    preference)."""
+    from ddl_tpu.utils import env_flag
+
+    return env_flag("DDL_TPU_INPLACE", override)
 
 
 def _abort_sentinel() -> str:
@@ -133,14 +150,21 @@ class DataPusher:
                 f"{init_ret.nData}",
             )
         self.window_nbytes = int(np.prod(self.shape)) * self.dtype.itemsize
-        self.inplace_fill = bool(
+        # Fill discipline: ``inplace_fill = True`` on the producer
+        # function FORCES slot-view fills (the original contract);
+        # ``supports_inplace_fill = True`` advertises write-once
+        # capability and lets the pusher decide — in place whenever no
+        # global shuffle needs a persistent private array and the
+        # ``DDL_TPU_INPLACE`` gate is on.  Resolved AFTER the shuffler
+        # below exists, since the shuffler is what forbids it.
+        self._forced_inplace = bool(
             getattr(meta.data_producer_function, "inplace_fill", False)
         )
+        self._auto_inplace = bool(
+            getattr(meta.data_producer_function, "supports_inplace_fill", False)
+        )
+        self.inplace_fill = self._forced_inplace
         self._fill_slot: Optional[int] = None
-
-        if not self.inplace_fill:
-            # Private window the user fills; commits copy it into ring slots.
-            self.my_ary = np.zeros(self.shape, dtype=self.dtype)
 
         # Global shuffler: registered as an additional callback when the
         # topology and config ask for it (reference datapusher.py:89-108) —
@@ -237,6 +261,22 @@ class DataPusher:
                         "string, or use the device exchange",
                     )
                 self.callbacks.append(self.shuffler)
+
+        # Auto inplace (write-once producers): a shuffler needs my_ary to
+        # persist across iterations (the exchange mutates it between
+        # fills), so capability-advertising producers silently keep the
+        # copying fill when one is active; otherwise they write straight
+        # into ring slots unless DDL_TPU_INPLACE=0 opts out.
+        if (
+            self._auto_inplace
+            and not self.inplace_fill
+            and self.shuffler is None
+            and inplace_enabled()
+        ):
+            self.inplace_fill = True
+        if not self.inplace_fill:
+            # Private window the user fills; commits copy it into ring slots.
+            self.my_ary = np.zeros(self.shape, dtype=self.dtype)
 
         # Integrity slots are one trailer header larger than the payload;
         # geometry (shape/splits/payload) is untouched.
@@ -495,6 +535,22 @@ class DataPusher:
                     my_ary=self.my_ary,
                     iteration=self._iteration,
                 )
+                if self.inplace_fill:
+                    # Chaos hook for the write-once path: fires with the
+                    # slot fully written but NOT yet stamped/committed —
+                    # a crash here leaves a torn slot (new payload under
+                    # the previous occupant's stale trailer) that must
+                    # never be served: stamp-after-fill means it is
+                    # never committed, and the drain-time verify is the
+                    # backstop if counting ever regressed.
+                    fault_point(
+                        "pusher.inplace_fill",
+                        producer_idx=self.producer_idx,
+                        view=self.ring.slot_view(self._fill_slot)[
+                            : self.window_nbytes
+                        ],
+                        should_abort=self.ring.is_shutdown,
+                    )
                 self._commit_window()
                 execute_callbacks(
                     self.callbacks, "on_shuffle_end", iteration=self._iteration

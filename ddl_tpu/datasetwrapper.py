@@ -68,9 +68,17 @@ class ProducerFunctionSkeleton(abc.ABC):
     is opt-in because slots rotate).  Contract: ``execute_function`` must
     fully write ``my_ary`` every call — its prior content is the window
     from ``nslots`` iterations ago, not the previous one.
+
+    ``supports_inplace_fill``: the soft variant — "every fill fully
+    rewrites the window, hand me a slot view when you can".  The pusher
+    then fills in place by default but silently keeps the private-array
+    fill when a cross-instance global shuffle needs ``my_ary`` to
+    persist, or when ``DDL_TPU_INPLACE=0`` opts out.  Every built-in
+    reader advertises it (write-once producers, docs/PERF_NOTES.md).
     """
 
     inplace_fill: bool = False
+    supports_inplace_fill: bool = False
 
     @abc.abstractmethod
     def on_init(self, **kwargs: Any) -> DataProducerOnInitReturn:

@@ -31,6 +31,12 @@ Injection points shipped today (site — fault kinds that act there):
                           top of ``DataPusher.push_data``'s window loop
 ``producer.commit``       ring-slot corruption (payload bytes flipped AFTER
                           the integrity header was written)
+``pusher.inplace_fill``   crash mid-write-once fill: fires with the live shm
+                          slot fully WRITTEN but not yet stamped/committed —
+                          a torn slot (new payload under the previous
+                          occupant's stale trailer) the consumer must never
+                          see
+
 ``producer.handshake``    crash during ``_producer_main`` construction
 ``ring.fill``/``ring.drain``  spurious shutdown / slowdown inside the ring
                           wait primitives (all three ring implementations)

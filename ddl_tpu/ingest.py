@@ -85,6 +85,47 @@ class DeviceIngestor:
             return True
         return self._target_platform() != "cpu"
 
+    @property
+    def batch_staged(self) -> bool:
+        """Should the BATCH paths (``put``/``put_batch``/prefetch
+        offload) stage through the recycled pool + background executor?
+
+        The CPU PJRT client zero-copy-aliases 64-byte-aligned host
+        buffers — which pooled ``np.empty`` staging buffers are — so on
+        CPU every buffer is alias-dropped after its first transfer: an
+        ALL-MISS pool whose per-transfer pointer walk, sweep, and gauge
+        bookkeeping are pure ceremony on top of the same fresh
+        allocation the inline path does plainly (measured on the 2-core
+        box: inline no-prefetch 83.2k vs staged 74.5k samples/s —
+        docs/PERF_NOTES.md "Write-once producers").  Accelerator puts
+        genuinely copy, the pool recycles, and the executor buys
+        overlap.  ``staged=True`` passed explicitly forces the engine
+        everywhere (tests, A/B measurement).
+
+        The decision predicate is deliberately the stream's (does this
+        client's put genuinely copy?), so it DELEGATES — two copies of
+        the same gate would drift."""
+        return self.stream_staged
+
+    @property
+    def stream_alias(self) -> bool:
+        """Should staged window-stream jobs ALIAS the ring slot (skip the
+        slot→staging memcpy)?  True on accelerators under the
+        ``DDL_TPU_SHM_STAGING`` gate: their ``device_put`` is a genuine
+        host→HBM copy, so once the transfer completes nothing reads the
+        slot and it can be released with ZERO host memcpys between
+        producer fill and HBM.  The CPU client may zero-copy-alias host
+        pages into "device" arrays, so it stays on the copying pool —
+        and the executor's per-transfer ``unsafe_buffer_pointer`` check
+        latches a fallback if an unrecognized client aliases anyway."""
+        from ddl_tpu.staging import shm_staging_enabled
+
+        return (
+            self.stream_staged
+            and shm_staging_enabled()
+            and self._target_platform() != "cpu"
+        )
+
     # -- staging engine ----------------------------------------------------
 
     def engine(self):
@@ -133,7 +174,7 @@ class DeviceIngestor:
 
         target = self.sharding if self.sharding is not None else self.device
         with annotate("ddl.ingest_put"):
-            if self.staged:
+            if self.batch_staged:
                 pool = self.engine().pool
                 out = []
                 for c in cols:
@@ -144,8 +185,9 @@ class DeviceIngestor:
                 out = tuple(out)
                 pool.sweep()
             else:
-                # The inline escape hatch (DDL_TPU_STAGED=0) IS the
-                # per-batch fresh copy — pragma'd, not pooled.
+                # Inline fresh copy: the DDL_TPU_STAGED=0 escape hatch
+                # AND the CPU-client default (an aliasing client makes
+                # the pool all-miss ceremony — see batch_staged).
                 out = tuple(
                     self._jax.device_put(
                         np.array(c, copy=True),  # ddl-lint: disable=DDL011
@@ -173,14 +215,15 @@ class DeviceIngestor:
         from ddl_tpu.profiling import annotate
 
         with annotate("ddl.ingest_put"):
-            if self.staged:
+            if self.batch_staged:
                 pool = self.engine().pool
                 buf = self._stage(batch)
                 dev = self._transfer(buf)
                 pool.recycle_when_ready(buf, dev)
                 pool.sweep()
             else:
-                # Inline escape hatch copy (DDL_TPU_STAGED=0).
+                # Inline fresh copy (DDL_TPU_STAGED=0, and the CPU-client
+                # default — see batch_staged).
                 dev = self._transfer(
                     np.array(batch, copy=True)  # ddl-lint: disable=DDL011
                 )
@@ -344,6 +387,11 @@ def north_star_report(
     report["pool_hits"] = m.counter("staging.pool_hits")
     report["pool_misses"] = m.counter("staging.pool_misses")
     report["queue_depth_max"] = m.gauge("staging.queue_depth.max")
+    # Shm-backed (zero-copy) staging: windows whose transfer sourced the
+    # ring slot directly (no slot→staging memcpy), and jobs the
+    # per-transfer alias check bounced back to the copying pool.
+    report["alias_windows"] = m.counter("staging.alias_windows")
+    report["alias_fallbacks"] = m.counter("staging.alias_fallbacks")
     # Training hot-path observability (ISSUE 5): time the trainer's
     # stream loop spent waiting for the next window (overlap health —
     # near zero when H2D hides behind the scans), time the loader spent
@@ -439,7 +487,10 @@ class PrefetchIterator:
         self._it = iter(it)
         self._ingestor = ingestor
         self._put = put or ingestor.put
-        self._transfer = transfer if ingestor.staged else None
+        # Gated on batch_staged: on the aliasing CPU client the executor
+        # handoff costs without buying overlap (all-miss pool), so fills
+        # go straight through `put` there.
+        self._transfer = transfer if ingestor.batch_staged else None
         self._depth = max(1, depth)
         self._queue: collections.deque = collections.deque()
 

@@ -226,10 +226,19 @@ class ArrayProducer(ProducerFunctionSkeleton):
             dtype=self.data.dtype,
         )
 
+    #: Every fill fully rewrites the window — safe to hand a live ring
+    #: slot (write-once producer discipline; see DataPusher).
+    supports_inplace_fill = True
+
     def _fill(self, my_ary: np.ndarray) -> None:
         pick = self._rng.choice(len(self._shard), self.window_size,
                                 replace=False)
-        np.copyto(my_ary, self._shard[pick])
+        # Gather straight into the (possibly ring-slot) window: one host
+        # write instead of materialize-then-copy.  mode="clip" (indices
+        # are in-range by construction) because mode="raise" forces
+        # numpy to buffer the output — re-adding the very copy pass the
+        # out= gather exists to delete.
+        self._shard.take(pick, axis=0, out=my_ary, mode="clip")
 
     def post_init(self, my_ary, **kw):
         self._fill(my_ary)
@@ -252,6 +261,11 @@ class FileShardProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
     RNG, so the served stream is identical whether a shard came from
     source or from cache.
     """
+
+    #: Each refill is one full permutation-gather into the window, so
+    #: PROCESS-mode pushers may hand this reader a live shm-slot view
+    #: (write-once: the commit memcpy disappears).
+    supports_inplace_fill = True
 
     def __init__(self, pattern: str, splits: Optional[Sequence[int]] = None,
                  seed: int = 0, backend: Any = None, cache: Any = None,
@@ -293,10 +307,15 @@ class FileShardProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
         self._cursor += 1
         # Cached arrays are shared and read-only, so the reshuffle is a
         # permutation GATHER into the window, never an in-place shuffle
-        # of the source (which would corrupt every later epoch's hit).
+        # of the source (which would corrupt every later epoch's hit) —
+        # and it gathers STRAIGHT into the window view (``out=``): the
+        # warm path then writes decoded bytes exactly once, into the shm
+        # slot itself on the inplace-fill path.
         arr = self._cached_shard(path, self._decode).reshape(my_ary.shape)
         perm = self._rng.permutation(len(arr))
-        np.copyto(my_ary, arr[perm])
+        # mode="clip": a permutation is in-range by construction, and
+        # mode="raise" would buffer the output (an extra copy pass).
+        arr.take(perm, axis=0, out=my_ary, mode="clip")
 
     def post_init(self, my_ary, **kw):
         self._load_next(my_ary)
@@ -314,6 +333,10 @@ class TokenStreamProducer(ProducerFunctionSkeleton):
     splits are ``(seq_len,)`` — the consumer reshapes into (B, T) int
     batches for the LM loss.
     """
+
+    #: Row-wise full rewrite per refill (and PackedTokenProducer's
+    #: segment pass reads only what the same call wrote) — live-slot safe.
+    supports_inplace_fill = True
 
     def __init__(self, token_file: str, seq_len: int, window_rows: int,
                  dtype: Any = np.int32, seed: int = 0):
@@ -425,6 +448,10 @@ class WebDatasetProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
     """
 
     _IMG_EXT = (".jpg", ".jpeg", ".png")
+
+    #: Rows are written once each, covering the whole window every fill
+    #: — decode lands in the shm slot itself on the inplace-fill path.
+    supports_inplace_fill = True
 
     def __init__(self, pattern: str, image_size: int = 32,
                  window_rows: int = 64, backend: Any = None,
@@ -833,6 +860,10 @@ class TFRecordTokenProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
     chunks regardless of their cut points).
     """
 
+    #: ``_fill`` streams token chunks straight into the flat window view
+    #: (no concatenate temp), fully rewriting it — live-slot safe.
+    supports_inplace_fill = True
+
     def __init__(self, pattern: str, seq_len: int, window_rows: int,
                  feature_key: Optional[str] = "input_ids",
                  verify_crc: Optional[bool] = None, backend: Any = None,
@@ -957,16 +988,25 @@ class TFRecordTokenProducer(_ShardCacheMixin, ProducerFunctionSkeleton):
         return toks.astype(np.int32)
 
     def _fill(self, my_ary: np.ndarray) -> None:
-        need = self.window_rows * self.seq_len
-        chunks = [self._buf]
-        have = len(self._buf)
-        while have < need:
+        # Write-once: token chunks land straight in the flat window view
+        # (a ring-slot view on the inplace path) as they arrive — the
+        # old concatenate-then-copy built a whole-window temp per fill.
+        # Chunk order and cut points are unchanged, so the served stream
+        # is byte-identical to the copying implementation.
+        flat = my_ary.reshape(-1)
+        need = flat.size
+        take = min(len(self._buf), need)
+        if take:
+            flat[:take] = self._buf[:take]
+        rest = self._buf[take:]
+        pos = take
+        while pos < need:
             toks = next(self._records)
-            chunks.append(toks)
-            have += len(toks)
-        self._buf = np.concatenate(chunks) if len(chunks) > 1 else self._buf
-        my_ary[:] = self._buf[:need].reshape(self.window_rows, self.seq_len)
-        self._buf = self._buf[need:]
+            take = min(len(toks), need - pos)
+            flat[pos : pos + take] = toks[:take]
+            rest = toks[take:]
+            pos += take
+        self._buf = rest
 
     def post_init(self, my_ary, **kw):
         self._fill(my_ary)

@@ -96,6 +96,16 @@ def staged_enabled(override: Optional[bool] = None) -> bool:
     return env_flag("DDL_TPU_STAGED", override)
 
 
+def shm_staging_enabled(override: Optional[bool] = None) -> bool:
+    """The ``DDL_TPU_SHM_STAGING`` gate (default ON): lets staged
+    window-stream jobs ALIAS the shm ring slot as their transfer source
+    (no slot→staging memcpy) on clients whose ``device_put`` genuinely
+    copies host memory.  ``0`` restores the copying pool everywhere."""
+    from ddl_tpu.utils import env_flag
+
+    return env_flag("DDL_TPU_SHM_STAGING", override)
+
+
 class StagingPool:
     """Thread-safe pool of reusable host staging buffers.
 
@@ -330,6 +340,7 @@ TransferFn = Callable[[np.ndarray], Tuple[Any, Any]]
 class _Job:
     __slots__ = (
         "handle", "src", "transfer", "expected_crc", "claimed", "worker",
+        "alias_src",
     )
 
     def __init__(
@@ -338,6 +349,7 @@ class _Job:
         src: np.ndarray,
         transfer: TransferFn,
         expected_crc: Optional[int] = None,
+        alias_src: bool = False,
     ):
         self.handle = handle
         self.src = src
@@ -347,6 +359,13 @@ class _Job:
         #: may be released — the second verification point of the
         #: end-to-end pipeline.
         self.expected_crc = expected_crc
+        #: Zero-copy staging (shm-backed): the transfer sources ``src``
+        #: — a live ring-slot view — directly, with no slot→staging
+        #: memcpy.  ``copy_done`` then fires only once the device value
+        #: no longer reads host memory (transfer completion), and the
+        #: per-transfer alias check guards clients that would zero-copy
+        #: the slot pages into the device array.
+        self.alias_src = alias_src
         self.claimed = False
         #: True when the background worker (not a stealing consumer)
         #: executed the job — the signal adaptive consumers use to judge
@@ -394,6 +413,12 @@ class TransferExecutor:
         #: ladder's "stop staging, go inline" latch, consulted by the
         #: lookahead consumers via ``StagedIngestEngine.faulted``.
         self.faulted = False
+        #: Latched when a client PROVED it zero-copy-aliases host pages
+        #: into device values (the per-transfer unsafe_buffer_pointer
+        #: walk fired on an alias job): every later alias submission
+        #: silently degrades to the copying pool — correctness first,
+        #: the memcpy saving only where it is safe.
+        self.alias_unsafe = False
         self._dq: Deque[_Job] = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
@@ -414,15 +439,26 @@ class TransferExecutor:
         src: np.ndarray,
         transfer: TransferFn,
         expected_crc: Optional[int] = None,
+        alias_src: bool = False,
     ) -> StagedTransfer:
         """Enqueue one job: copy ``src`` into a pooled buffer, then run
         ``transfer`` on it.  ``src`` may be a live ring-slot view — the
         caller must keep the slot acquired until ``handle.copy_done``.
         ``expected_crc`` (the committed window CRC) re-verifies the copy
         before that release.  Blocks when the queue is full
-        (backpressure)."""
+        (backpressure).
+
+        ``alias_src=True`` (shm-backed staging) skips the slot→staging
+        memcpy entirely: the transfer sources ``src`` directly and
+        ``copy_done`` fires at transfer COMPLETION — the caller holds
+        the slot for the DMA instead of one memcpy, and pays zero host
+        copies.  Ignored (degraded to the copying pool) once a client
+        proved it aliases host pages (``alias_unsafe``)."""
         handle = StagedTransfer()
-        job = _Job(handle, src, transfer, expected_crc)
+        job = _Job(
+            handle, src, transfer, expected_crc,
+            alias_src=alias_src and not self.alias_unsafe,
+        )
         handle._job = job
         with self._cv:
             if self._closed:
@@ -620,6 +656,9 @@ class TransferExecutor:
             return job.transfer(buf)
 
         try:
+            if job.alias_src:
+                handle._value = self._execute_alias(job)
+                return
             buf = self.pool.acquire(job.src.shape, job.src.dtype)
             self._retrying("copy", copy_phase)
             handle.copy_done.set()  # source released: slot may free
@@ -646,6 +685,77 @@ class TransferExecutor:
         finally:
             handle.copy_done.set()
             handle.ready.set()
+
+    def _execute_alias(self, job: _Job) -> Any:
+        """Run one zero-copy (shm-backed) job: transfer straight from the
+        ring-slot view, no staging memcpy.
+
+        The slot stays the transfer's live source, so ``copy_done`` (the
+        caller's release edge, set by ``_execute``'s ``finally``) may
+        only fire once the device value stopped reading host memory:
+        after a completion wait on a genuinely-copying client, or after
+        the copying-pool fallback on one that aliased the slot pages
+        into the device array (checked per transfer with the same
+        ``unsafe_buffer_pointer`` walk the pool uses — the check firing
+        latches ``alias_unsafe`` so later jobs skip straight to the
+        pool).  The wait runs on the background worker (or a stealing
+        consumer that needed the value NOW anyway), never adds a host
+        memcpy, and its span lands in ``ingest.transfer``.
+        """
+        def transfer_phase():
+            fault_point("staging.transfer")
+            return job.transfer(job.src)
+
+        def salvage_slot(buf: Optional[np.ndarray] = None) -> None:
+            """Terminal transfer failure with the slot STILL HELD (this
+            runs before ``_execute``'s ``finally`` fires ``copy_done``
+            and lets the consumer release it): retain a host copy of the
+            window so ``complete_or_salvage`` can redo it down the
+            sanctioned inline path — the alias path must keep the
+            copying path's degradation-ladder guarantee that a link
+            failure costs latency, never data."""
+            if buf is None:
+                buf = self.pool.acquire(job.src.shape, job.src.dtype)
+                np.copyto(buf, job.src, casting="no")
+            job.handle.salvage = buf
+
+        t0 = time.perf_counter()
+        try:
+            value, base = self._retrying("transfer", transfer_phase)
+        except (ShutdownRequested, KeyboardInterrupt):
+            raise
+        except Exception:
+            salvage_slot()
+            raise
+        if _may_alias(base, job.src):
+            # The client zero-copied the slot pages into the device
+            # value: releasing the slot would let the producer overwrite
+            # data the device array still reads.  Redo through the
+            # copying pool (the discarded first value holds no readers)
+            # and stop submitting alias jobs on this client.
+            self.alias_unsafe = True
+            self.metrics.incr("staging.alias_fallbacks")
+            logger.warning(
+                "shm-backed staging: device client aliases host pages; "
+                "falling back to the copying staging pool"
+            )
+            buf = self.pool.acquire(job.src.shape, job.src.dtype)
+            np.copyto(buf, job.src, casting="no")
+            try:
+                value, base = self._retrying(
+                    "transfer", lambda: job.transfer(buf)
+                )
+            except (ShutdownRequested, KeyboardInterrupt):
+                raise
+            except Exception:
+                salvage_slot(buf)  # the copy already landed: keep it
+                raise
+            self.pool.recycle_when_ready(buf, base)
+            return value
+        _block_ready(base)
+        self.metrics.add_time("ingest.transfer", time.perf_counter() - t0)
+        self.metrics.incr("staging.alias_windows")
+        return value
 
     def _run(self) -> None:
         while True:
@@ -747,8 +857,11 @@ class StagedIngestEngine:
         src: np.ndarray,
         transfer: TransferFn,
         expected_crc: Optional[int] = None,
+        alias_src: bool = False,
     ) -> StagedTransfer:
-        return self.executor.submit(src, transfer, expected_crc)
+        return self.executor.submit(
+            src, transfer, expected_crc, alias_src=alias_src
+        )
 
     def close(self) -> None:
         self.executor.close()

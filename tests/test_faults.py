@@ -68,7 +68,16 @@ class PatternProducer(ProducerFunctionSkeleton):
         my_ary[:] = pattern(self.it, self.idx)
 
 
-def drain_numpy(plan, n_epochs=6, metrics=None, stall_budget_s=60.0):
+class InplacePatternProducer(PatternProducer):
+    """The same deterministic pattern stream, FORCED write-once: every
+    fill lands straight in the live ring slot (module-level so PROCESS
+    chaos tests can pickle it across the spawn boundary)."""
+
+    inplace_fill = True
+
+
+def drain_numpy(plan, n_epochs=6, metrics=None, stall_budget_s=60.0,
+                producer_cls=PatternProducer):
     """Run a 1-producer THREAD pipeline under ``plan``; return the window
     arrays served, the watchdog, and the metrics registry."""
     m = metrics or Metrics()
@@ -81,7 +90,7 @@ def drain_numpy(plan, n_epochs=6, metrics=None, stall_budget_s=60.0):
         ).start()
         try:
             loader = DistributedDataLoader(
-                PatternProducer(), batch_size=N_DATA,
+                producer_cls(), batch_size=N_DATA,
                 connection=env.connection, n_epochs=n_epochs,
                 output="numpy", timeout_s=60.0, metrics=m,
             )
@@ -221,6 +230,48 @@ class TestFaultMatrix:
             main()
         assert m.counter("integrity.replays") == 2  # DDL_TPU_MAX_REPLAYS
         assert m.counter("integrity.corrupt_windows") >= 3
+
+    def test_inplace_crash_mid_fill_respawned_byte_identical(self):
+        """PRODUCER_CRASH at the ``pusher.inplace_fill`` site: the ring
+        slot is fully WRITTEN but not yet stamped/committed — the torn
+        slot (new payload under the previous occupant's stale trailer)
+        must never reach the consumer.  Write-once ordering (stamp AFTER
+        fill, commit after stamp) guarantees it is never committed; the
+        respawned incarnation rejoins the surviving ring, reads the last
+        COMMITTED slot's header for its exact position, and re-fills the
+        torn slot from scratch.  Byte-identical, exactly once, zero
+        corrupt windows observed."""
+        plan = FaultPlan(
+            [FaultSpec("pusher.inplace_fill", FaultKind.PRODUCER_CRASH,
+                       at=3)]
+        )
+        windows, wd, m = drain_numpy(
+            plan, producer_cls=InplacePatternProducer
+        )
+        assert_byte_identical(windows, 6)
+        assert list(wd.respawns) == [1]
+        assert list(wd.failures) == []
+        assert m.counter("integrity.corrupt_windows") == 0
+        assert plan.fired and plan.fired[0][0] == "pusher.inplace_fill"
+
+    def test_inplace_torn_commit_quarantined_and_replayed(self):
+        """A torn COMMITTED slot on the write-once path (bytes flipped
+        after the trailer stamp — what a real shared-memory scribble
+        looks like): the drain-time CRC quarantines it, and the replay
+        rewinds the inplace producer THROUGH ITS LIVE SLOT VIEW
+        (on_init → post_init → fast_forward all write into the acquired
+        slot).  Served stream byte-identical, exactly once."""
+        plan = FaultPlan(
+            [FaultSpec("producer.commit", FaultKind.RING_CORRUPTION,
+                       at=2, param=4)]
+        )
+        windows, wd, m = drain_numpy(
+            plan, producer_cls=InplacePatternProducer
+        )
+        assert_byte_identical(windows, 6)
+        assert m.counter("integrity.corrupt_windows") == 1
+        assert m.counter("integrity.replays") == 1
+        assert list(wd.failures) == []
 
     def test_staging_copy_fault_retried(self):
         """A transient staging-copy failure is retried with backoff; the
@@ -614,12 +665,22 @@ class TestChaosSoak:
         assert list(wd.failures) == []
         assert plan.fired, "no scheduled fault ever fired"
 
-    def test_process_mode_crash_respawn_with_exported_plan(self, tmp_path):
+    @pytest.mark.parametrize("producer_cls,site", [
+        (PatternProducer, "producer.fill"),
+        # Write-once producers: the crash fires mid-inplace-fill with a
+        # torn shm slot behind it — the respawn must re-fill it, never
+        # serve it (tier-1 has the THREAD twin; this one crosses the
+        # real spawn boundary over the native shm ring).
+        (InplacePatternProducer, "pusher.inplace_fill"),
+    ])
+    def test_process_mode_crash_respawn_with_exported_plan(
+        self, producer_cls, site
+    ):
         """PROCESS mode: the plan crosses the spawn boundary via
         DDL_TPU_FAULT_PLAN and the spawned producer injects its own
         crash; elastic recovery still delivers the exact stream."""
         plan = FaultPlan(
-            [FaultSpec("producer.fill", FaultKind.PRODUCER_CRASH, at=3)]
+            [FaultSpec(site, FaultKind.PRODUCER_CRASH, at=3)]
         )
         m = Metrics()
 
@@ -631,7 +692,7 @@ class TestChaosSoak:
             ).start()
             try:
                 loader = DistributedDataLoader(
-                    PatternProducer(), batch_size=N_DATA,
+                    producer_cls(), batch_size=N_DATA,
                     connection=env.connection, n_epochs=6,
                     output="numpy", timeout_s=120.0, metrics=m,
                 )

@@ -1,6 +1,9 @@
 """Ingest path tests: DeviceIngestor, PrefetchIterator, epoch resync."""
 
+import os
+
 import numpy as np
+import pytest
 
 from ddl_tpu import (
     DataProducerOnInitReturn,
@@ -184,6 +187,7 @@ class TestNorthStarReport:
             # staged-ingest extras (ddl_tpu.staging)
             "stage_copy_s", "transfer_s", "stall_s",
             "pool_hits", "pool_misses", "queue_depth_max",
+            "alias_windows", "alias_fallbacks",
             # robustness extras (ISSUE 3: watchdog + integrity + ladder)
             "respawns", "watchdog_failures", "corrupt_windows",
             "replays", "shuffle_degraded", "staging_retries",
@@ -732,3 +736,241 @@ class TestDeferredSlotRelease:
         first, seen = main()
         assert first == 1001.0
         assert seen and all(v == 2001.0 for v in seen), seen
+
+
+class AutoInplaceProducer(ProducerFunctionSkeleton):
+    """Capability-advertising producer: every fill fully rewrites, so
+    the pusher MAY hand it a live slot view (but must not when a global
+    shuffle needs a persistent my_ary)."""
+
+    supports_inplace_fill = True
+
+    def on_init(self, producer_idx=0, **kw):
+        self.iteration = 0
+        return DataProducerOnInitReturn(
+            nData=32, nValues=4, shape=(32, 4), splits=(3, 1)
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        self.iteration += 1
+        my_ary[:] = self.iteration
+
+
+class TestAutoInplaceFill:
+    """The extended inplace contract (write-once producers): a
+    ``supports_inplace_fill`` producer fills live ring slots by default,
+    degrades to the copying fill when a shuffler owns my_ary, and obeys
+    the ``DDL_TPU_INPLACE`` escape hatch — which never overrides a
+    producer that FORCES ``inplace_fill``."""
+
+    def _pusher(self, producer, shuffle=0.0, n_instances=1, factory=None):
+        from ddl_tpu.datapusher import DataPusher
+        from ddl_tpu.transport.connection import (
+            ProducerConnection,
+            ThreadChannel,
+        )
+        from ddl_tpu.types import (
+            MetaData_Consumer_To_Producer,
+            RunMode,
+            Topology,
+        )
+
+        topo = Topology(
+            n_instances=n_instances, instance_idx=0, n_producers=1,
+            mode=RunMode.THREAD,
+        )
+        cons_end, prod_end = ThreadChannel.pair()
+        cons_end.send(
+            MetaData_Consumer_To_Producer(
+                data_producer_function=producer, batch_size=8,
+                n_epochs=1, global_shuffle_fraction_exchange=shuffle,
+                exchange_method="sendrecv_replace",
+            )
+        )
+        return DataPusher(
+            ProducerConnection(prod_end, 1, cross_process=False),
+            topo, 1, shuffler_factory=factory,
+        )
+
+    def test_builtin_readers_advertise_capability(self):
+        from ddl_tpu.readers import (
+            ArrayProducer,
+            FileShardProducer,
+            TFRecordTokenProducer,
+            TokenStreamProducer,
+            WebDatasetProducer,
+        )
+
+        for cls in (
+            ArrayProducer, FileShardProducer, WebDatasetProducer,
+            TokenStreamProducer, TFRecordTokenProducer,
+        ):
+            assert cls.supports_inplace_fill is True
+            assert cls.inplace_fill is False  # opt-in stays the pusher's
+
+    def test_auto_inplace_gets_live_slot_view(self):
+        p = self._pusher(AutoInplaceProducer())
+        assert p.inplace_fill is True
+        assert p._fill_slot is not None
+        assert np.shares_memory(
+            p.my_ary, p.ring.slot_view(p._fill_slot)
+        )
+
+    def test_env_escape_hatch_restores_copy_fill(self, monkeypatch):
+        monkeypatch.setenv("DDL_TPU_INPLACE", "0")
+        p = self._pusher(AutoInplaceProducer())
+        assert p.inplace_fill is False
+        assert not any(
+            np.shares_memory(p.my_ary, p.ring.slot_view(s))
+            for s in range(p.ring.nslots)
+        )
+
+    def test_env_escape_hatch_never_overrides_forced(self, monkeypatch):
+        monkeypatch.setenv("DDL_TPU_INPLACE", "0")
+        p = self._pusher(InplaceSeqProducer())
+        assert p.inplace_fill is True  # forced = contract, not preference
+
+    def test_auto_degrades_under_global_shuffle(self):
+        """Unlike FORCED inplace (rejected — see
+        test_inplace_fill_rejects_global_shuffle), a capability
+        advertisement quietly keeps the private my_ary the exchange
+        needs."""
+        from ddl_tpu.shuffle import ThreadExchangeShuffler
+
+        p = self._pusher(
+            AutoInplaceProducer(), shuffle=0.5, n_instances=2,
+            factory=ThreadExchangeShuffler.factory(),
+        )
+        assert p.shuffler is not None
+        assert p.inplace_fill is False
+
+
+class TestWriteOnceByteIdentity:
+    """PROCESS inplace stream ≡ THREAD stream ≡ the old copying PROCESS
+    path (``DDL_TPU_INPLACE=0``), cache-on and cache-off, for every
+    built-in shard reader: the write-once refactor must change copy
+    counts, never bytes."""
+
+    def _drain(self, make_producer, mode, batch_size, n_epochs=3):
+        @distributed_dataloader(n_producers=1, mode=mode)
+        def main(env):
+            loader = DistributedDataLoader(
+                make_producer(), batch_size=batch_size,
+                connection=env.connection, n_epochs=n_epochs,
+                output="numpy",
+            )
+            out = []
+            for _ in range(n_epochs):
+                for cols in loader:
+                    out.append(
+                        np.hstack([np.asarray(c) for c in cols]).copy()
+                    )
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return np.stack(out)
+
+        return main()
+
+    #: label -> (run mode, DDL_TPU_INPLACE, cache on).  The THREAD
+    #: cache-off run is the reference stream.
+    MATRIX = {
+        "thread": ("thread", "1", False),
+        "thread_cache": ("thread", "1", True),
+        "process_inplace": ("process", "1", False),
+        "process_inplace_cache": ("process", "1", True),
+        "process_copy": ("process", "0", False),
+        "process_copy_cache": ("process", "0", True),
+    }
+
+    def _assert_matrix_identical(
+        self, make_producer, batch_size, monkeypatch, tmp_path
+    ):
+        runs = {}
+        for label, (mode, inplace, cache_on) in self.MATRIX.items():
+            monkeypatch.setenv("DDL_TPU_INPLACE", inplace)
+            if cache_on:
+                monkeypatch.setenv("DDL_TPU_CACHE", "1")
+                monkeypatch.setenv(
+                    "DDL_TPU_CACHE_SPILL_DIR",
+                    str(tmp_path / f"spill_{label}"),
+                )
+            else:
+                monkeypatch.delenv("DDL_TPU_CACHE", raising=False)
+                monkeypatch.delenv(
+                    "DDL_TPU_CACHE_SPILL_DIR", raising=False
+                )
+            runs[label] = self._drain(make_producer, mode, batch_size)
+        ref = runs["thread"]
+        for label, got in runs.items():
+            np.testing.assert_array_equal(
+                got, ref,
+                err_msg=f"{label} stream diverged from the THREAD "
+                "cache-off reference",
+            )
+
+    def test_fileshard_matrix(self, tmp_path, monkeypatch):
+        rng = np.random.default_rng(0)
+        for i in range(2):
+            np.save(
+                tmp_path / f"shard_{i}.npy",
+                rng.standard_normal((8, 6)).astype(np.float32),
+            )
+        pattern = str(tmp_path / "shard_*.npy")
+
+        from ddl_tpu.readers import FileShardProducer
+
+        self._assert_matrix_identical(
+            lambda: FileShardProducer(pattern, seed=0, warm=False),
+            batch_size=4, monkeypatch=monkeypatch, tmp_path=tmp_path,
+        )
+
+    def test_tfrecord_matrix(self, tmp_path, monkeypatch):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from datagen import encode_example_int64, write_tfrecord
+
+        payloads = [
+            encode_example_int64(
+                "input_ids", list(range(20 * i, 20 * i + 20))
+            )
+            for i in range(4)
+        ]
+        path = str(tmp_path / "toks.tfrecord")
+        write_tfrecord(path, payloads)
+
+        from ddl_tpu.readers import TFRecordTokenProducer
+
+        self._assert_matrix_identical(
+            lambda: TFRecordTokenProducer(
+                str(tmp_path / "toks.tfrecord"), seq_len=8,
+                window_rows=4, warm=False,
+            ),
+            batch_size=4, monkeypatch=monkeypatch, tmp_path=tmp_path,
+        )
+
+    def test_webdataset_matrix(self, tmp_path, monkeypatch):
+        pytest.importorskip("PIL")
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from datagen import write_image_shard
+
+        write_image_shard(
+            str(tmp_path / "imgs.tar"),
+            [(f"s{i:03d}", i % 3) for i in range(4)],
+            size=8,
+        )
+
+        from ddl_tpu.readers import WebDatasetProducer
+
+        self._assert_matrix_identical(
+            lambda: WebDatasetProducer(
+                str(tmp_path / "imgs.tar"), image_size=8,
+                window_rows=4, warm=False,
+            ),
+            batch_size=4, monkeypatch=monkeypatch, tmp_path=tmp_path,
+        )

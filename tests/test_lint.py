@@ -155,6 +155,15 @@ VIOLATIONS = {
                 x = layer_fn(x, layer)
             return x
     """,
+    "DDL015": """
+        import numpy as np
+
+        class FileShardProducer:
+            def _load_next(self, my_ary):
+                arr = self._shard()
+                perm = self._rng.permutation(len(arr))
+                np.copyto(my_ary, arr[perm])   # fancy-index temp + copy
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -307,6 +316,28 @@ CLEAN = {
         def load_state(path):
             return jax.checkpoint.restore(path)  # not the remat transform
     """,
+    "DDL015": """
+        import numpy as np
+
+        class FileShardProducer:
+            def _load_next(self, my_ary):
+                arr = self._shard()
+                perm = self._rng.permutation(len(arr))
+                arr.take(perm, axis=0, out=my_ary)   # write-once gather
+
+        class StreamBank:
+            def execute_function(self, my_ary):
+                # basic slice = view source: one copy total, sanctioned
+                np.copyto(my_ary, self._bank[self._off : self._off + 4])
+
+        class TFRecordTokenProducer:
+            def _fill(self, my_ary):
+                flat = my_ary.reshape(-1)
+                flat[:4] = self._buf[:4]       # slice into the view
+
+        def host_side(my_ary, arr, perm):
+            np.copyto(my_ary, arr[perm])       # not a fill function
+    """,
 }
 
 
@@ -391,6 +422,39 @@ class TestSelfTest:
         """
         findings = lint_snippet(tmp_path, "DDL013", src)
         assert findings == [], findings
+
+    def test_ddl015_assignment_and_concat_forms_fire(self, tmp_path):
+        """The slice-assignment spelling of the double copy fires too,
+        including through a .reshape() of the materialized temp."""
+        src = """
+            import numpy as np
+
+            class TFRecordTokenProducer:
+                def _fill(self, my_ary):
+                    chunks = self._chunks()
+                    my_ary[:] = np.concatenate(chunks).reshape(4, 4)
+        """
+        findings = lint_snippet(tmp_path, "DDL015", src)
+        assert [f.code for f in findings] == ["DDL015"]
+        assert "concatenate" in findings[0].message
+
+    def test_ddl015_respects_configured_fill_list(self, tmp_path):
+        """A function outside producer_fill_functions stays clean — the
+        check is repo policy (config'd hot list), not a global ban."""
+        src = """
+            import numpy as np
+
+            class CustomProducer:
+                def _fill(self, my_ary):
+                    arr = self._shard()
+                    np.copyto(my_ary, arr[self._perm()])
+        """
+        cfg = LintConfig(producer_fill_functions=["KnownProducer._fill"])
+        findings = lint_snippet(tmp_path, "DDL015", src, config=cfg)
+        assert findings == [], findings
+        cfg = LintConfig(producer_fill_functions=["CustomProducer._fill"])
+        findings = lint_snippet(tmp_path, "DDL015", src, config=cfg)
+        assert [f.code for f in findings] == ["DDL015"]
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

@@ -212,6 +212,56 @@ class TestFormatAndGates:
 
 
 @pytest.mark.skipif(not TSO, reason="cross-process py ring needs TSO")
+class TestInplaceOverPyRing:
+    def test_process_inplace_stream_byte_identical_on_py_ring(
+        self, force_py, tmp_path, monkeypatch
+    ):
+        """The write-once PROCESS path over the PYTHON shm ring: a real
+        spawned producer fills FileShardProducer windows straight into
+        PyShmRing slots (DDL_TPU_INPLACE=1) and the served stream is
+        byte-identical to the copying fill (DDL_TPU_INPLACE=0) on the
+        same transport."""
+        from ddl_tpu import (
+            DistributedDataLoader,
+            Marker,
+            distributed_dataloader,
+        )
+        from ddl_tpu.readers import FileShardProducer
+
+        rng = np.random.default_rng(3)
+        for i in range(2):
+            np.save(
+                tmp_path / f"shard_{i}.npy",
+                rng.standard_normal((8, 4)).astype(np.float32),
+            )
+        pattern = str(tmp_path / "shard_*.npy")
+
+        def drain(inplace):
+            monkeypatch.setenv("DDL_TPU_INPLACE", inplace)
+
+            @distributed_dataloader(n_producers=1, mode="process")
+            def main(env):
+                loader = DistributedDataLoader(
+                    FileShardProducer(pattern, seed=0, warm=False),
+                    batch_size=4, connection=env.connection,
+                    n_epochs=2, output="numpy",
+                )
+                out = []
+                for _ in range(2):
+                    for cols in loader:
+                        out.append(np.hstack(
+                            [np.asarray(c) for c in cols]
+                        ).copy())
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+                return np.stack(out)
+
+            return main()
+
+        np.testing.assert_array_equal(drain("1"), drain("0"))
+
+
+@pytest.mark.skipif(not TSO, reason="cross-process py ring needs TSO")
 class TestLoaderRide:
     def test_thread_mode_loader_served_by_forced_py_ring(
         self, force_py, monkeypatch

@@ -9,8 +9,13 @@ Two passes:
 1. `DDL_BENCH_MODE=ingest` with a small window/batch geometry — the
    last stdout line must parse as JSON and carry the staged-ingest
    extras (`staging.stage_copy_s` etc.), the staged-vs-inline pair,
-   the robustness/cache blocks, and the `headline_config` label (the
-   bench must never headline a config it measured as slower).
+   the robustness/cache blocks, and the `headline_config` label.
+   Asserted gates (retried once against one-sided box noise): the
+   headline is never slower than any sibling batch config the same run
+   measured, `vs_baseline >= 1.0` on the CPU batch path (interleaved
+   measurement in bench.py), `ingest.process_vs_thread >= 0.9` OR the
+   `ingest.core_attach` record proves core starvation, and a non-TPU
+   run embeds the `last_tpu_artifact` trail (+ `git_head`).
 2. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
    schedule/bubble gauges) and its `pipeline_overhead` against the
@@ -33,7 +38,27 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: Keys the ingest headline must always carry.
-REQUIRED = ("metric", "value", "unit", "platform", "headline_config")
+REQUIRED = (
+    "metric", "value", "unit", "platform", "headline_config", "git_head",
+)
+#: Sibling config blocks the headline must never undercut (the
+#: never-headline-a-slower-config invariant, checked against every
+#: batch-path samples/s the same run measured).
+COMPETING_BLOCKS = (
+    "ingest_no_prefetch", "ingest_inline", "ingest_process_mode",
+)
+#: The ingest block: PROCESS-vs-THREAD stream ratio + core attach.
+REQUIRED_INGEST = ("process_vs_thread", "core_attach")
+#: PROCESS-mode stream must reach this fraction of THREAD-mode
+#: utilization — unless the same JSON's core-attach record proves the
+#: box cannot host every producer process + the consumer (starved).
+MIN_PROCESS_VS_THREAD = 0.9
+#: The CPU batch path must beat the reference design point (strict
+#: alternation + per-batch sync); vs_baseline is measured interleaved
+#: in bench.py, retried here once against residual box noise.
+MIN_VS_BASELINE = 1.0
+#: last_tpu_artifact summary keys (present whenever the block is a dict).
+REQUIRED_ARTIFACT = ("path", "metric", "value", "unit")
 #: fit_stream contract (ISSUE 5): throughput + matched ceiling +
 #: overlap-health counters + schedule gauges.
 REQUIRED_FIT = (
@@ -48,6 +73,7 @@ FIT_ATTEMPTS = 2
 REQUIRED_STAGING = (
     "stage_copy_s", "transfer_s", "stall_s",
     "pool_hits", "pool_misses", "queue_depth_max",
+    "alias_windows", "alias_fallbacks",
 )
 #: Robustness extras (north_star_report robustness block) — all zero on
 #: a healthy run, but the KEYS must always be present so BENCH_*
@@ -100,43 +126,116 @@ def _run_bench(mode: str) -> "dict | None":
         return None
 
 
-def main() -> int:
-    result = _run_bench("ingest")
-    if result is None:
-        return 1
+def _measured_gates(result: dict) -> "list[str]":
+    """Noise-sensitive assertions, retried once by the caller: the
+    headline-never-slower invariant, the CPU-batch vs_baseline floor,
+    and the PROCESS-vs-THREAD stream ratio (or its starvation proof)."""
+    problems = []
+    value = result.get("value") or 0.0
+    for key in COMPETING_BLOCKS:
+        rate = result.get(key, {}).get("samples_per_sec")
+        if rate is not None and rate > value:
+            problems.append(
+                f"headline {value} is slower than {key} {rate} the same "
+                "run measured (never-slower invariant)"
+            )
+    vs_baseline = result.get("vs_baseline")
+    if vs_baseline is None:
+        problems.append("vs_baseline missing")
+    elif vs_baseline < MIN_VS_BASELINE:
+        problems.append(
+            f"vs_baseline {vs_baseline} < {MIN_VS_BASELINE} on the CPU "
+            "batch path"
+        )
+    ingest = result.get("ingest", {})
+    ratio = ingest.get("process_vs_thread")
+    starved = ingest.get("core_attach", {}).get("starved")
+    if ratio is None:
+        problems.append("ingest.process_vs_thread missing")
+    elif ratio < MIN_PROCESS_VS_THREAD and not starved:
+        problems.append(
+            f"ingest.process_vs_thread {ratio} < {MIN_PROCESS_VS_THREAD} "
+            "with no core-starvation proof in ingest.core_attach"
+        )
+    return problems
 
-    missing = [k for k in REQUIRED if k not in result]
-    staging = result.get("staging")
-    if not isinstance(staging, dict):
-        missing.append("staging")
-    else:
-        missing += [
-            f"staging.{k}" for k in REQUIRED_STAGING if k not in staging
-        ]
-    robustness = result.get("robustness")
-    if not isinstance(robustness, dict):
-        missing.append("robustness")
-    else:
-        missing += [
-            f"robustness.{k}"
-            for k in REQUIRED_ROBUSTNESS
-            if k not in robustness
-        ]
-    cache = result.get("cache")
-    if not isinstance(cache, dict):
-        missing.append("cache")
-    else:
-        missing += [f"cache.{k}" for k in REQUIRED_CACHE if k not in cache]
-    if "ingest_inline" not in result and "errors" not in result:
-        missing.append("ingest_inline")
-    if missing:
+
+def main() -> int:
+    for attempt in range(1, 3):
+        result = _run_bench("ingest")
+        if result is None:
+            return 1
+
+        missing = [k for k in REQUIRED if k not in result]
+        staging = result.get("staging")
+        if not isinstance(staging, dict):
+            missing.append("staging")
+        else:
+            missing += [
+                f"staging.{k}" for k in REQUIRED_STAGING if k not in staging
+            ]
+        robustness = result.get("robustness")
+        if not isinstance(robustness, dict):
+            missing.append("robustness")
+        else:
+            missing += [
+                f"robustness.{k}"
+                for k in REQUIRED_ROBUSTNESS
+                if k not in robustness
+            ]
+        cache = result.get("cache")
+        if not isinstance(cache, dict):
+            missing.append("cache")
+        else:
+            missing += [
+                f"cache.{k}" for k in REQUIRED_CACHE if k not in cache
+            ]
+        ingest = result.get("ingest")
+        if not isinstance(ingest, dict):
+            missing.append("ingest")
+        else:
+            missing += [
+                f"ingest.{k}" for k in REQUIRED_INGEST if k not in ingest
+            ]
+        # Trustworthy-headline contract: a non-TPU run must point at the
+        # newest committed chip artifact (None only if the repo has no
+        # committed TPU artifact at all).
+        if result.get("platform") != "tpu":
+            if "last_tpu_artifact" not in result:
+                missing.append("last_tpu_artifact")
+            else:
+                art = result["last_tpu_artifact"]
+                if isinstance(art, dict):
+                    missing += [
+                        f"last_tpu_artifact.{k}"
+                        for k in REQUIRED_ARTIFACT
+                        if k not in art
+                    ]
+                elif art is not None:
+                    missing.append("last_tpu_artifact (not a dict)")
+        if "ingest_inline" not in result and "errors" not in result:
+            missing.append("ingest_inline")
+        if missing:
+            print(json.dumps(result, indent=1))
+            print(f"bench-smoke: missing keys: {missing}")
+            return 1
+        if result.get("value") is None:
+            print(json.dumps(result, indent=1))
+            print("bench-smoke: headline value is null "
+                  f"(errors={result.get('errors')})")
+            return 1
+        gate_problems = _measured_gates(result)
+        if not gate_problems:
+            break
+        if attempt < 2:
+            print(
+                f"bench-smoke: measured gates failed ({gate_problems}); "
+                "retrying once (one-sided box noise)"
+            )
+            continue
         print(json.dumps(result, indent=1))
-        print(f"bench-smoke: missing keys: {missing}")
-        return 1
-    if result.get("value") is None:
-        print(json.dumps(result, indent=1))
-        print("bench-smoke: headline value is null "
-              f"(errors={result.get('errors')})")
+        for p in gate_problems:
+            print(f"bench-smoke: {p}")
         return 1
     # The cache A/B is an ASSERTED contract, not just a present one: a
     # warm tier that stopped winning (or — worse — stopped serving the
@@ -194,11 +293,14 @@ def main() -> int:
 
     staged = result["value"]
     inline = result.get("ingest_inline", {}).get("samples_per_sec")
+    ing = result.get("ingest", {})
     print(
         "bench-smoke: OK — headline "
         f"{result.get('headline_config')} {staged} vs inline {inline} "
-        "samples/s; staging + robustness extras present; cache "
-        f"warm/cold "
+        f"samples/s; vs_baseline {result.get('vs_baseline')}; "
+        f"process/thread {ing.get('process_vs_thread')} "
+        f"(starved={ing.get('core_attach', {}).get('starved')}); "
+        "staging + robustness extras present; cache warm/cold "
         f"{cache.get('warm_vs_cold') if isinstance(cache, dict) else '?'}x "
         "byte-identical; fit_stream overhead "
         f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "

@@ -5,6 +5,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     concurrency,
     ingest_path,
     jax_hazards,
+    producer_fill,
     protocol,
 )
 from tools.ddl_lint.checkers.base import REGISTRY, Checker, register

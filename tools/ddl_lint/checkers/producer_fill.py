@@ -1,0 +1,160 @@
+"""Producer-fill hazards: materialize-then-copy into the window view.
+
+The write-once producer discipline (``DataPusher`` inplace fill) hands
+fill functions a LIVE ring-slot view as ``my_ary`` — the whole point is
+that decoded/gathered bytes land in shared memory exactly once.  A fill
+that first materializes a temporary (``arr[perm]`` fancy indexing,
+``np.concatenate(chunks)``) and then copies it into ``my_ary`` silently
+re-adds a whole-window host copy at window cadence — precisely the
+commit memcpy the inplace path deleted, now hiding inside the reader.
+This checker makes that a lint failure instead of a perf regression
+hunted in a bench trajectory months later.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import dotted_name
+
+#: The window-view parameter name of the producer-fill contract
+#: (``ProducerFunctionSkeleton`` hooks receive ``my_ary=``).
+_VIEW_NAME = "my_ary"
+
+#: Calls that materialize a fresh whole-window temporary.
+_MATERIALIZERS = {
+    "concatenate", "stack", "hstack", "vstack", "column_stack", "tile",
+    "repeat",
+}
+
+
+@register
+class ProducerFillDoubleCopy(Checker):
+    """DDL015: materialize-then-copy into the producer window view.
+
+    Functions named in ``[tool.ddl_lint] producer_fill_functions`` (bare
+    names or ``Class.method``) fill producer windows that may be live
+    ring-slot views (``supports_inplace_fill`` / ``inplace_fill``).
+    Inside them, flag writes of a freshly materialized temporary into
+    the ``my_ary`` view:
+
+    - ``np.copyto(my_ary, arr[perm])`` / ``my_ary[...] = arr[perm]`` —
+      fancy indexing mints a whole-window temp; gather straight into
+      the view instead (``arr.take(perm, axis=0, out=my_ary,
+      mode="clip")`` — ``mode="raise"`` would buffer the output),
+    - ``np.copyto(my_ary, np.concatenate(...))`` / ``my_ary[:] =
+      np.stack(...)`` — assemble-then-copy; stream pieces into the view.
+
+    Plain-slice sources (``bank[a:b]`` — a view, one copy total) and
+    name sources stay clean: one copy into the slot is the floor for
+    data that must come from somewhere else.
+
+    Escape hatch: ``# ddl-lint: disable=DDL015`` with a rationale.
+    """
+
+    code = "DDL015"
+    summary = "materialize-then-copy into the producer window view"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_fill(node):
+            self._check_body(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_fill(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        fill = getattr(self.config, "producer_fill_functions", [])
+        return fn.name in fill or qual in fill  # type: ignore[attr-defined]
+
+    def _check_body(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            # np.copyto(my_ary, <temp>)
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func) or ""
+                if (
+                    dotted.rsplit(".", 1)[-1] == "copyto"
+                    and len(node.args) >= 2
+                    and self._is_view(node.args[0])
+                ):
+                    why = self._temp_source(node.args[1])
+                    if why:
+                        self._flag(node, why)
+            # my_ary[...] = <temp>
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and self._is_view(tgt.value)
+                    ):
+                        why = self._temp_source(node.value)
+                        if why:
+                            self._flag(node, why)
+                        break
+
+    def _is_view(self, node: ast.AST) -> bool:
+        """Is this expression the window view (``my_ary`` or a reshape
+        of it, e.g. ``my_ary.reshape(-1)``)?"""
+        if isinstance(node, ast.Name) and node.id == _VIEW_NAME:
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "reshape"
+        ):
+            return self._is_view(node.func.value)
+        return False
+
+    def _temp_source(self, src: ast.AST) -> Optional[str]:
+        """A description of the whole-window temporary ``src`` mints, or
+        None when the source is a view/name (one-copy floor)."""
+        if isinstance(src, ast.Subscript) and not self._is_basic_slice(
+            src.slice
+        ):
+            return "fancy-index temp"
+        if isinstance(src, ast.Call):
+            # X.reshape(...) reshapes a view; classify its base instead
+            # (checked on the raw attribute: the base may itself be a
+            # call, which has no dotted name).
+            if (
+                isinstance(src.func, ast.Attribute)
+                and src.func.attr == "reshape"
+            ):
+                return self._temp_source(src.func.value)
+            dotted = dotted_name(src.func) or ""
+            seg = dotted.rsplit(".", 1)[-1]
+            if seg in _MATERIALIZERS:
+                return f"{seg}(...) temp"
+        return None
+
+    @staticmethod
+    def _is_basic_slice(idx: ast.AST) -> bool:
+        """Basic slicing returns a VIEW (no temp): ``a[lo:hi]``,
+        ``a[lo:hi, ...]``.  Anything else (a name, an array expression,
+        a tuple with a non-slice element) is treated as fancy indexing."""
+        if isinstance(idx, ast.Slice):
+            return True
+        if isinstance(idx, ast.Tuple):
+            return all(
+                isinstance(e, (ast.Slice, ast.Constant)) for e in idx.elts
+            )
+        return isinstance(idx, ast.Constant)
+
+    def _flag(self, node: ast.AST, why: str) -> None:
+        self.report(
+            node,
+            f"window view written from a {why} in a producer fill "
+            "function; gather/stream straight into the view (e.g. "
+            "arr.take(perm, axis=0, out=my_ary, mode=\"clip\") — "
+            "mode=\"raise\" buffers the output) — the inplace path "
+            "hands a live ring slot here, and the temp re-adds the "
+            "whole-window copy the write-once discipline deleted",
+        )

@@ -39,6 +39,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL012",  # unbounded blocking wait (no timeout) on a framework path
     "DDL013",  # unbounded module/instance-level dict cache (no eviction)
     "DDL014",  # jax.checkpoint/remat without an explicit policy
+    "DDL015",  # materialize-then-copy into the producer window view
 )
 
 
@@ -69,6 +70,20 @@ class LintConfig:
             "DeviceIngestor.put_batch",
             "PrefetchIterator.__next__",
             "TransferExecutor._run",
+        ]
+    )
+    #: Producer fill functions (bare name or ``Class.method``) whose
+    #: ``my_ary`` may be a LIVE ring-slot view (inplace fill): writing a
+    #: freshly materialized temp into it is DDL015 (gather straight into
+    #: the view instead).
+    producer_fill_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "ArrayProducer._fill",
+            "FileShardProducer._load_next",
+            "WebDatasetProducer._fill",
+            "TokenStreamProducer._fill",
+            "PackedTokenProducer._fill",
+            "TFRecordTokenProducer._fill",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -227,6 +242,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     cfg.lock_order = str_list("lock_order", cfg.lock_order)
     cfg.ingest_hot_path_functions = str_list(
         "ingest_hot_path_functions", cfg.ingest_hot_path_functions
+    )
+    cfg.producer_fill_functions = str_list(
+        "producer_fill_functions", cfg.producer_fill_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
