@@ -35,7 +35,7 @@ import numpy as np
 from ddl_tpu import integrity
 from ddl_tpu.datasetwrapper import DataProducerOnInitReturn
 from ddl_tpu.exceptions import DoesNotMatchError, ShutdownRequested
-from ddl_tpu.faults import fault_point
+from ddl_tpu.faults import armed_plan, fault_point
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import NOTHING, ProducerConnection
 from ddl_tpu.types import (
@@ -535,14 +535,17 @@ class DataPusher:
                     my_ary=self.my_ary,
                     iteration=self._iteration,
                 )
-                if self.inplace_fill:
+                if self.inplace_fill and armed_plan() is not None:
                     # Chaos hook for the write-once path: fires with the
                     # slot fully written but NOT yet stamped/committed —
                     # a crash here leaves a torn slot (new payload under
                     # the previous occupant's stale trailer) that must
                     # never be served: stamp-after-fill means it is
                     # never committed, and the drain-time verify is the
-                    # backstop if counting ever regressed.
+                    # backstop if counting ever regressed.  The byte view
+                    # costs a ring FFI call per window, so it is built
+                    # only behind the armed check (the disarmed push loop
+                    # stays zero-cost, faults.py's contract).
                     fault_point(
                         "pusher.inplace_fill",
                         producer_idx=self.producer_idx,

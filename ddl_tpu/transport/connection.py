@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import abc
 import copy
+import logging
 import queue as queue_mod
 import threading
 from typing import Any, List, Optional, Sequence
@@ -29,6 +30,8 @@ from ddl_tpu.types import (
     MetaData_Consumer_To_Producer,
     MetaData_Producer_To_Consumer,
 )
+
+logger = logging.getLogger("ddl_tpu")
 
 _HANDSHAKE_TIMEOUT_S = 600.0
 
@@ -268,20 +271,26 @@ class ConsumerConnection:
         # open) or the new one — never a closed-but-unswapped slot.
         with self._lock:
             if self._finalized:
-                # The run ended while this rejoin was in flight (e.g. the
-                # watchdog's bounded join timed out and the consumer
-                # finalized): swapping in would leak an open channel into
-                # a dead connection and report a phantom "successful"
-                # respawn.  The fresh worker exits via its ring's
-                # persistent shutdown flag.
+                # The run ended while this rejoin's control-plane recv was
+                # in flight.  The reply above already VALIDATED: the
+                # replacement completed its handshake and has been serving
+                # the surviving ring directly (the data path never waits
+                # on this swap), so a consumer that drained to completion
+                # and finalized meanwhile is a recovery that raced run
+                # completion — a success, not a failure to escalate.
+                # Swapping in would leak an open channel into a dead
+                # connection, so drop the channel instead; the fresh
+                # worker exits via its ring's persistent shutdown flag.
                 try:
                     channel.close()
                 except OSError:  # pragma: no cover - best-effort
                     pass
-                raise TransportError(
-                    f"rejoin of producer {producer_idx} arrived after "
-                    "finalize; dropping the replacement channel"
+                logger.info(
+                    "rejoin of producer %d completed after finalize; "
+                    "replacement channel dropped",
+                    producer_idx,
                 )
+                return reply
             try:
                 self.channels[i].close()
             except OSError:  # pragma: no cover - already-broken pipe

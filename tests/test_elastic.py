@@ -605,3 +605,88 @@ def test_fast_forward_default_replays_execute_function():
     b.execute_function(my_ary=buf)
     assert b.it == 6 and a.it == 5
     assert float(buf[0, 0]) == 6.0
+
+
+def test_rejoin_racing_run_completion_is_a_success():
+    """A respawned producer serves the surviving ring DIRECTLY — the data
+    path never waits on the consumer-side channel swap.  So a consumer
+    that drains the replacement's windows to completion and finalizes
+    while the watchdog's ``rejoin_producer`` recv is still in flight has
+    witnessed a SUCCESSFUL recovery: the validated-late rejoin must
+    return (dropping the replacement channel on the dead connection),
+    not raise — raising misreports a completed run as a watchdog failure
+    (the full-suite-load flake in test_crash_respawn_data_continuity)."""
+    from ddl_tpu.transport.connection import ConsumerConnection, ThreadChannel
+    from ddl_tpu.types import (
+        MetaData_Consumer_To_Producer,
+        MetaData_Producer_To_Consumer,
+    )
+
+    a, b = ThreadChannel.pair()
+    conn = ConsumerConnection([a])
+    conn.send_metadata(
+        MetaData_Consumer_To_Producer(
+            data_producer_function=None, batch_size=16, n_epochs=6
+        )
+    )
+    b.recv(timeout_s=5)
+    geometry = dict(
+        producer_idx=1, n_data=16, n_values=4, shape=(16, 4),
+        splits=(3, 1), batches_per_window=1,
+    )
+    b.send(MetaData_Producer_To_Consumer(**geometry))
+    conn.recv_metadata_as_consumer()
+
+    # The run ends (consumer drained everything) while the replacement's
+    # control-plane handshake is still queued.
+    conn.finalize()
+    a2, b2 = ThreadChannel.pair()
+    late_reply = MetaData_Producer_To_Consumer(**geometry)
+    b2.send(late_reply)
+
+    got = conn.rejoin_producer(1, a2)
+    assert got is late_reply
+    # No swap into the dead connection: the finalized channel list is
+    # untouched, so nothing open leaks past finalize.
+    assert conn.channels[0] is a
+
+
+def test_rejoin_after_finalize_still_rejects_bad_geometry():
+    """The finalize race is forgiven only for a VALIDATED reply: a
+    replacement reporting different geometry than its predecessor fails
+    the rejoin regardless of when the run ended."""
+    import pytest as _pytest
+
+    from ddl_tpu.exceptions import TransportError
+    from ddl_tpu.transport.connection import ConsumerConnection, ThreadChannel
+    from ddl_tpu.types import (
+        MetaData_Consumer_To_Producer,
+        MetaData_Producer_To_Consumer,
+    )
+
+    a, b = ThreadChannel.pair()
+    conn = ConsumerConnection([a])
+    conn.send_metadata(
+        MetaData_Consumer_To_Producer(
+            data_producer_function=None, batch_size=16, n_epochs=6
+        )
+    )
+    b.recv(timeout_s=5)
+    b.send(
+        MetaData_Producer_To_Consumer(
+            producer_idx=1, n_data=16, n_values=4, shape=(16, 4),
+            splits=(3, 1), batches_per_window=1,
+        )
+    )
+    conn.recv_metadata_as_consumer()
+    conn.finalize()
+
+    a2, b2 = ThreadChannel.pair()
+    b2.send(
+        MetaData_Producer_To_Consumer(
+            producer_idx=1, n_data=16, n_values=4, shape=(8, 8),
+            splits=(3, 1), batches_per_window=1,
+        )
+    )
+    with _pytest.raises(TransportError, match="different\\s+geometry"):
+        conn.rejoin_producer(1, a2)
