@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench check chaos
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun check chaos
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -28,6 +28,19 @@ bench-smoke:
 cache-bench:
 	DDL_BENCH_MODE=cache JAX_PLATFORMS=cpu $(PY) bench.py
 
+# ICI distribution A/B (Pallas fan-out + redistribution vs the XLA
+# scatter; docs/PERF_NOTES.md "ICI ingest").  On a TPU pod this is the
+# real-DMA measurement; elsewhere it runs interpret-mode on the
+# virtual mesh and the JSON carries the last_tpu_artifact trail.
+ici-bench:
+	DDL_BENCH_MODE=ici $(PY) bench.py
+
+# Fan-out kernel dry run on whatever devices exist (interpret mode on
+# CPU: per-hop bytes/s for both modes + one full redistribution) —
+# the mirror of tools/probe_ingest.py for the post-H2D hop.
+ici-dryrun:
+	$(PY) tools/probe_ici.py
+
 # The one-shot local gate: static analysis + bench JSON contract (the
 # bench-smoke contract includes the cache block's byte-identity and
 # >=2x warm-vs-cold assertions).
@@ -35,6 +48,7 @@ check: lint bench-smoke
 
 # Chaos suite: deterministic fault matrix + randomized multi-fault soak
 # (includes slow PROCESS-mode spawns; docs/ROBUSTNESS.md) + the cache
-# corruption/backend-failure ladder (tests/test_cache.py).
+# corruption/backend-failure ladder (tests/test_cache.py) + the ICI
+# DMA-failure → xla-fallback rung (tests/test_ici.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py -q

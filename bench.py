@@ -34,10 +34,13 @@ winner re-runs; and ``ingest.process_vs_thread`` ships with a per-leg
 transport regressions.
 
 Env knobs: DDL_BENCH_PLATFORM=tpu|cpu (skip probing), DDL_BENCH_MODE=
-ingest|train|all|big|stream|decode (default all; "big" runs ONLY the
-HBM-filling train config, "stream" ONLY the window-stream configs —
-the chip-checklist window-size sweep — and "decode" ONLY the
-serving-phase prefill+decode config), DDL_BENCH_PROBE_TIMEOUT_S
+ingest|train|all|big|stream|decode|cache|ici (default all; "big" runs
+ONLY the HBM-filling train config, "stream" ONLY the window-stream
+configs — the chip-checklist window-size sweep — "decode" ONLY the
+serving-phase prefill+decode config, "cache" the shard-cache cold/warm
+A/B, and "ici" the device-side distribution A/B: Pallas fan-out +
+redistribution vs the XLA scatter, DDL_BENCH_ICI_MIB /
+DDL_BENCH_ICI_REPS geometry), DDL_BENCH_PROBE_TIMEOUT_S
 (default 300), DDL_BENCH_STREAM_MIB / DDL_BENCH_LOOKAHEAD /
 DDL_BENCH_NSLOTS (stream geometry), DDL_BENCH_DECODE_BATCH (serving
 batch for the decode configs; default 8 on TPU).  Pipeline knobs that
@@ -108,6 +111,30 @@ _PEAK_HBM = (
 def _peak_hbm(device_kind: str) -> float | None:
     kind = device_kind.lower()
     for sub, peak in _PEAK_HBM:
+        if sub in kind:
+            return peak
+    return None
+
+
+# Per-LINK ICI bandwidth, bytes/s one direction, by device_kind substring
+# (public spec-sheet per-chip totals divided by the link count: v2 496/4,
+# v3 656/4, v4 2400/6, v5e 1600/4, v5p 4800/6, v6e 3584/4 Gbps).  The
+# ring fan-out drives ONE link per chip per step, so the per-hop spec —
+# not the per-chip aggregate — is the honest utilization denominator for
+# the DDL_BENCH_MODE=ici leg.
+_PEAK_ICI_LINK = (
+    ("v6", 112e9, 4),  # Trillium / v6e
+    ("v5p", 100e9, 6),
+    ("v5", 50e9, 4),  # v5e
+    ("v4", 50e9, 6),
+    ("v3", 20.5e9, 4),
+    ("v2", 15.5e9, 4),
+)
+
+
+def _peak_ici_link(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak, _links in _PEAK_ICI_LINK:
         if sub in kind:
             return peak
     return None
@@ -1279,6 +1306,136 @@ def _run_cache_ab() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _ensure_virtual_mesh(n: int) -> None:
+    """Force an n-device CPU virtual mesh BEFORE the first backend touch
+    (the ici A/B needs a ring to fan out over; a plain CPU attach exposes
+    one device).  No-op when the flag is already set — and harmless on
+    TPU, where this is never called."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _run_ici_ab(platform: str) -> dict:
+    """The ICI ingest A/B (ROADMAP item 1): one window, H2D onto the
+    anchor device, then distributed to a dp-sharded target two ways —
+    ``ici`` (Pallas fan-out ring + redistribution legs,
+    ddl_tpu/parallel/ici.py) vs ``xla`` (the pre-existing
+    ``device_put`` scatter) — measured INTERLEAVED, best-of both sides.
+
+    Two ratios come out: ``vs_xla`` (end-to-end, the ici-vs-xla
+    competition under the never-slower headline invariant) and
+    ``bandwidth_utilization`` — the fan-out's measured per-hop wire rate
+    over the platform's per-LINK ICI spec (``_PEAK_ICI_LINK``), the
+    BASELINE.md ≥0.90 target's denominator.  Off-TPU the kernel runs in
+    interpret mode on the virtual mesh (byte-identity + contract-shape
+    proof; the utilization denominator is null — there is no ICI).
+
+    Geometry knobs: ``DDL_BENCH_ICI_MIB`` (window size, default 64 on
+    TPU / 1 interpreted), ``DDL_BENCH_ICI_REPS`` (default 5).
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.parallel.ici import IciDistributor
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    if n_dev < 2:
+        raise RuntimeError(f"ici A/B needs >= 2 devices, found {n_dev}")
+    mesh = Mesh(np.array(devices), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+
+    interpret = platform != "tpu"
+    mib = int(os.environ.get("DDL_BENCH_ICI_MIB", "1" if interpret else "64"))
+    cols = N_VALUES
+    rows = max(n_dev, mib * (1 << 20) // (cols * 4) // n_dev * n_dev)
+    win = np.random.default_rng(0).random((rows, cols)).astype(np.float32)
+    reps = int(os.environ.get("DDL_BENCH_ICI_REPS", "5"))
+
+    m = Metrics()
+    dist = IciDistributor(sharding, metrics=m)
+    plan = dist.plan(win.shape, win.dtype)  # PlanError -> errors block
+
+    # Warmup both paths (compiles) + the byte-identity check.
+    out_i = dist.put(win, jax.device_put)
+    out_x = jax.device_put(win, sharding)
+    jax.block_until_ready((out_i, out_x))
+    byte_identical = bool(
+        np.array_equal(np.asarray(out_i), np.asarray(out_x))
+    )
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        return time.perf_counter() - t0
+
+    # End-to-end (H2D + distribution), interleaved so neither side owns
+    # the quiet minutes (the PR 6 vs_baseline discipline).
+    ici_s, xla_s = [], []
+    for _ in range(reps):
+        ici_s.append(timed(lambda: dist.put(win, jax.device_put)))
+        xla_s.append(timed(lambda: jax.device_put(win, sharding)))
+
+    # Distribution-only (anchor-resident source): the ICI hop itself,
+    # the wire-rate numerator — H2D excluded from the clock.
+    anchor_block = jax.device_put(win, plan.anchor)
+    jax.block_until_ready(anchor_block)
+    dist_s = min(timed(lambda: dist.distribute(anchor_block))
+                 for _ in range(reps))
+
+    if dist.faulted:
+        # A latched fallback mid-bench means the "ici" timings silently
+        # measured the xla path — that is not a result.
+        raise RuntimeError(
+            "ici distributor latched the xla fallback during the A/B "
+            f"(ici.fallbacks={m.counter('ici.fallbacks')})"
+        )
+
+    nbytes = win.nbytes
+    ici_rate = nbytes / min(ici_s)
+    xla_rate = nbytes / min(xla_s)
+    winner = "ici" if ici_rate >= xla_rate else "xla"
+    wire_rate = plan.wire_bytes / dist_s
+    per_hop = wire_rate / n_dev  # symmetric ring: wire bytes / link
+    link_spec = (
+        _peak_ici_link(devices[0].device_kind) if platform == "tpu"
+        else None
+    )
+    util = per_hop / link_spec if link_spec else 0.0
+    block = {
+        "n_devices": n_dev,
+        "window_mib": round(nbytes / 2**20, 2),
+        "interpret": interpret,
+        "plan_mode": plan.mode,
+        "plan_legs": [leg.kind for leg in plan.legs],
+        "peak_factor": round(plan.peak_factor, 3),
+        "peak_bytes": plan.peak_bytes,
+        # The ici-vs-xla competition: the block's headline bytes/s is
+        # the WINNER's (never a config this run measured slower).
+        "bytes_per_s": round(max(ici_rate, xla_rate), 1),
+        "winner": winner,
+        "ici_bytes_per_s": round(ici_rate, 1),
+        "xla_bytes_per_s": round(xla_rate, 1),
+        "vs_xla": round(ici_rate / xla_rate, 3),
+        "byte_identical": byte_identical,
+        # The ICI hop itself: wire bytes the fan-out+legs moved per
+        # window over the distribution-only span, per ring link.
+        "wire_bytes": plan.wire_bytes,
+        "wire_bytes_per_s": round(wire_rate, 1),
+        "per_hop_bytes_per_s": round(per_hop, 1),
+        "link_spec_bytes_per_s": link_spec,
+        "bandwidth_utilization": round(util, 4),
+        "fanout_s": round(m.timer("ici.fanout").total_s, 4),
+        "redistribute_s": round(m.timer("ici.redistribute").total_s, 4),
+        "fallbacks": m.counter("ici.fallbacks"),
+    }
+    return _gate_utilization(block, "ici per-hop")
+
+
 # -- driver -------------------------------------------------------------------
 
 
@@ -1317,6 +1474,29 @@ def main() -> None:
             result["value"] = result["cache"]["warm_vs_cold"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["cache"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "ici":
+        # `make ici-bench` / chip_checklist step: the device-side
+        # distribution A/B (Pallas fan-out + redistribution vs the XLA
+        # scatter), with the winner as the headline — the ici-vs-xla
+        # competition rides the same never-headline-slower invariant as
+        # the ingest configs (bench_smoke enforces).  Off-TPU the leg
+        # runs interpret-mode on the 8-device virtual mesh and the
+        # last_tpu_artifact trail (stamped above) marks it a fallback.
+        result["metric"] = "ici_bytes_per_sec"
+        result["unit"] = "bytes/s"
+        try:
+            if platform != "tpu":
+                _ensure_virtual_mesh(8)
+            result["ici"] = _run_ici_ab(platform)
+            result["value"] = result["ici"]["bytes_per_s"]
+            result["headline_config"] = result["ici"]["winner"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["ici"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

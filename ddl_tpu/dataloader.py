@@ -101,6 +101,7 @@ class DistributedDataLoader:
         metrics: Optional[Metrics] = None,
         timeout_s: float = 300.0,
         staged: Optional[bool] = None,
+        distribute: Optional[str] = None,
     ):
         if output not in ("torch", "numpy", "jax"):
             raise ValueError(f"output must be torch|numpy|jax, got {output!r}")
@@ -137,10 +138,13 @@ class DistributedDataLoader:
         if output == "jax":
             from ddl_tpu.ingest import DeviceIngestor
 
-            # ``staged=None`` defers to the DDL_TPU_STAGED env gate.
+            # ``staged=None`` defers to the DDL_TPU_STAGED env gate;
+            # ``distribute=None`` to DDL_TPU_DISTRIBUTE (default "auto":
+            # the post-H2D hop rides the ICI fan-out tier on accelerator
+            # meshes, the XLA scatter elsewhere — ddl_tpu/parallel/ici).
             self._ingestor = DeviceIngestor(
                 device=device, sharding=sharding, metrics=self.metrics,
-                staged=staged,
+                staged=staged, distribute=distribute,
             )
 
         # -- handshake -----------------------------------------------------

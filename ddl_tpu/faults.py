@@ -51,6 +51,11 @@ Injection points shipped today (site — fault kinds that act there):
                           the retry budget; persistent beyond it →
                           ``IntegrityError``), fired inside
                           ``cache.open_with_retry`` before every attempt
+``ici.fanout``            ICI DMA-leg failure inside
+                          ``IciDistributor.distribute`` (before the
+                          fan-out kernel dispatch) — the distributor
+                          latches a fallback to the ``xla`` scatter path
+                          and counts ``ici.fallbacks``
 ========================  ====================================================
 """
 
@@ -86,6 +91,7 @@ class FaultKind(enum.Enum):
     SPURIOUS_SHUTDOWN = "spurious_shutdown"
     CACHE_CORRUPTION = "cache_corruption"
     BACKEND_FETCH_FAIL = "backend_fetch_fail"
+    ICI_DMA_FAIL = "ici_dma_fail"
 
 
 @dataclasses.dataclass
@@ -236,6 +242,7 @@ class FaultPlan:
         elif kind in (
             FaultKind.STAGING_COPY_FAIL,
             FaultKind.STAGED_TRANSFER_FAIL,
+            FaultKind.ICI_DMA_FAIL,
         ):
             raise InjectedFault(f"{kind.value} {where}")
         elif kind is FaultKind.BACKEND_FETCH_FAIL:

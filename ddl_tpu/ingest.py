@@ -17,6 +17,7 @@ TPU the HBM hop is mandatory, so hiding it is a core feature
 from __future__ import annotations
 
 import collections
+import os
 import time
 from typing import Any, Optional, Sequence, Tuple
 
@@ -48,6 +49,7 @@ class DeviceIngestor:
         sharding: Any = None,
         metrics: Optional[Metrics] = None,
         staged: Optional[bool] = None,
+        distribute: Optional[str] = None,
     ):
         import jax
 
@@ -62,6 +64,22 @@ class DeviceIngestor:
         #: stream distinguishes "forced on" from "default on" (below).
         self._staged_arg = staged
         self._engine: Any = None
+        #: Post-H2D distribution tier: "ici" routes the device-side hop
+        #: through the Pallas fan-out + redistribution planner
+        #: (ddl_tpu/parallel/ici.py), "xla" keeps the pre-existing
+        #: sharded device_put, "auto" (the default, also via the
+        #: DDL_TPU_DISTRIBUTE env) picks ici on accelerator meshes and
+        #: xla on the CPU client (where there is no ICI to control) —
+        #: DDL_TPU_ICI_INGEST=0 is the auto-mode kill switch.
+        distribute = distribute or os.environ.get(
+            "DDL_TPU_DISTRIBUTE", "auto"
+        )
+        if distribute not in ("ici", "xla", "auto"):
+            raise ValueError(
+                f"distribute must be ici|xla|auto, got {distribute!r}"
+            )
+        self.distribute = distribute
+        self._ici: Any = None  # lazily-built IciDistributor
 
     @property
     def stream_staged(self) -> bool:
@@ -126,6 +144,46 @@ class DeviceIngestor:
             and self._target_platform() != "cpu"
         )
 
+    @property
+    def ici_active(self) -> bool:
+        """Does the post-H2D hop ride the ICI tier (fan-out kernel +
+        redistribution planner) instead of an XLA-scattered
+        ``device_put``?
+
+        Requires a multi-device ``NamedSharding`` target and a single
+        JAX process (the multihost assembly path owns its own
+        distribution).  ``distribute="ici"`` forces the tier anywhere —
+        including the CPU virtual mesh, where the kernel runs in
+        interpret mode (that is how tier-1 proves byte identity);
+        ``"auto"`` engages it only on accelerator meshes, gated by
+        ``DDL_TPU_ICI_INGEST`` (default on — the distributor latches an
+        xla fallback on any DMA failure, so auto cannot strand a run).
+        """
+        if self.distribute == "xla" or self.sharding is None:
+            return False
+        if getattr(self.sharding, "mesh", None) is None:
+            return False  # ici needs a named mesh to plan over
+        if len(self.sharding.device_set) <= 1:
+            return False
+        if self._jax.process_count() > 1:
+            return False
+        if self.distribute == "ici":
+            return True
+        return (
+            self._target_platform() != "cpu"
+            and os.environ.get("DDL_TPU_ICI_INGEST", "1") != "0"
+        )
+
+    def ici(self):
+        """The lazily-built ICI distributor (plan + kernel caches)."""
+        if self._ici is None:
+            from ddl_tpu.parallel.ici import IciDistributor
+
+            self._ici = IciDistributor(
+                self.sharding, metrics=self.metrics
+            )
+        return self._ici
+
     # -- staging engine ----------------------------------------------------
 
     def engine(self):
@@ -172,14 +230,13 @@ class DeviceIngestor:
         """
         from ddl_tpu.profiling import annotate
 
-        target = self.sharding if self.sharding is not None else self.device
         with annotate("ddl.ingest_put"):
             if self.batch_staged:
                 pool = self.engine().pool
                 out = []
                 for c in cols:
                     buf = self._stage(c)
-                    dev = self._jax.device_put(buf, target)
+                    dev = self._transfer(buf)
                     pool.recycle_when_ready(buf, dev)
                     out.append(dev)
                 out = tuple(out)
@@ -189,9 +246,8 @@ class DeviceIngestor:
                 # AND the CPU-client default (an aliasing client makes
                 # the pool all-miss ceremony — see batch_staged).
                 out = tuple(
-                    self._jax.device_put(
-                        np.array(c, copy=True),  # ddl-lint: disable=DDL011
-                        target,
+                    self._transfer(
+                        np.array(c, copy=True)  # ddl-lint: disable=DDL011
                     )
                     for c in cols
                 )
@@ -247,12 +303,21 @@ class DeviceIngestor:
     def _transfer(self, arr: np.ndarray) -> Any:
         """One host→device transfer honouring the multihost case: with
         multiple JAX processes each host contributes its local shard of
-        the global array (same assembly as :func:`make_global_array`)."""
+        the global array (same assembly as :func:`make_global_array`).
+
+        With the ICI tier active the hop splits in two: H2D lands the
+        whole buffer on the plan's anchor device (one link crossing),
+        then the fan-out kernel + redistribution legs move it to the
+        target sharding entirely over ICI — XLA never scatters from the
+        host.  The distributor owns its own failure ladder (latched xla
+        fallback), so this seam stays exception-free."""
         target = self.sharding if self.sharding is not None else self.device
         if self.sharding is not None and self._jax.process_count() > 1:
             return self._jax.make_array_from_process_local_data(
                 self.sharding, arr
             )
+        if self.ici_active:
+            return self.ici().put(arr, self._jax.device_put)
         return self._jax.device_put(arr, target)
 
     def put_window(
@@ -431,6 +496,17 @@ def north_star_report(
     report["cache_quarantined"] = m.counter("cache.quarantined")
     report["cache_resident_bytes"] = m.gauge("cache.resident_bytes")
     report["cache_resident_bytes_max"] = m.gauge("cache.resident_bytes.max")
+    # ICI ingest tier (ddl_tpu/parallel/ici.py, ISSUE 7): wire bytes the
+    # device-side fan-out moved, dispatch time split between the Pallas
+    # kernel and the redistribution legs, the plan's asserted per-device
+    # peak, and fallback latches (a nonzero ici_fallbacks on a run that
+    # "passed" means the tier degraded to the xla path mid-stream).
+    report["ici_bytes"] = m.counter("ici.bytes")
+    report["ici_windows"] = m.counter("ici.windows")
+    report["ici_fallbacks"] = m.counter("ici.fallbacks")
+    report["ici_fanout_s"] = m.timer("ici.fanout").total_s
+    report["ici_redistribute_s"] = m.timer("ici.redistribute").total_s
+    report["ici_peak_bytes"] = m.gauge("ici.peak_bytes")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

@@ -15,7 +15,11 @@ next-window waits, near zero when H2D overlaps the scans), ``pp.*``
 (``pp.bubble`` / ``pp.chunks`` gauges — the analytic bubble and chunk
 count of the last-compiled pipeline schedule), ``staging.*`` (the
 staged-ingest engine), ``watchdog.*`` / ``integrity.*`` / ``shuffle.*``
-(robustness events), and ``cache.*`` (the shard cache —
+(robustness events), ``ici.*`` (the device-side distribution tier —
+``ici.bytes``/``ici.windows``/``ici.fallbacks`` counters, the
+``ici.fanout``/``ici.redistribute`` dispatch timers, and the
+``ici.peak_bytes`` gauge asserted by the redistribution planner), and
+``cache.*`` (the shard cache —
 ``cache.hits/misses/evictions/spills/spill_hits/spill_evictions/
 quarantined/warmed/backend_retries/backend_failures`` counters plus
 ``cache.resident_bytes`` / ``cache.spill_bytes`` gauges, whose ``.max``

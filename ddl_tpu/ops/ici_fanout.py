@@ -1,0 +1,497 @@
+"""Pallas ICI fan-out kernels: device-side window distribution.
+
+After six PRs every byte still entered the pod through one host's
+``device_put`` — the window crossed H2D once and was then scattered by
+XLA with no measurement or control of the ICI hop (ROADMAP item 1).
+These kernels make that hop explicit: one source device's committed
+window is replicated (ring broadcast) or sharded (ring scatter) across a
+1-axis device ring entirely over ICI with ``pltpu.make_async_remote_copy``
+DMAs, double-buffered so chunk N+1's DMA overlaps chunk N's wait.
+
+Kernel shape constraints (why the code looks the way it does):
+
+- **Permute-shaped steps.**  Interpret mode (the CPU virtual-mesh test
+  path) discharges a remote DMA as a *collective*: every device in the
+  axis must execute every ``dma_start`` in lockstep, and the target map
+  of each step must deliver exactly one copy to every device
+  (``jax/_src/pallas/mosaic/primitives.py`` gathers ``device_id`` with
+  ``lax.all_gather`` and ``argmax``-selects the sender).  Role-gated
+  sends (``pl.when(is_source)``) therefore deadlock under interpret —
+  both kernels instead run a full right-rotation every step, with the
+  chunk schedule clamped so devices ahead of / behind the pipeline send
+  repeats of valid edge chunks.
+- **Sink chunk.**  The rotation wraps: the ring tail sends to the
+  source every step.  Early steps that send would carry garbage into
+  the source's *live* window (a read-write race on real hardware), so
+  the tail redirects its wrap-around send into a dedicated sink chunk
+  past the payload — dead bytes on a link the broadcast cannot use
+  anyway.
+- **Double buffering.**  DMA semaphores are parity pairs (``sem[t % 2]``):
+  step ``t`` starts its send, *then* waits step ``t-1``'s send — one
+  send is always in flight while the previous one drains.  The scatter
+  kernel's transit buffer is a ``(2, block)`` VMEM ping-pong for the
+  same reason: the forward of step ``t`` reads the half the recv of
+  step ``t`` is not writing.
+
+The wrappers fall back to ``interpret=True`` off-TPU, which is how the
+CPU suite validates byte identity against the host path (tier-1); on a
+real pod the same kernels compile through Mosaic (``collective_id`` is
+reserved per mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+from jax import numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ddl_tpu._compat import shard_map
+
+#: The fan-out ring's private mesh axis (always 1-axis: interpret-mode
+#: remote DMA only supports a single named dimension, and the
+#: redistribution planner owns the mapping onto dp x fsdp x tp).
+AXIS = "x"
+
+#: Default chunk count for the broadcast pipeline.  More chunks deepen
+#: the pipeline (per-chunk latency hides behind the ring) but add
+#: (n_dev - 2) clamped edge sends of one chunk each; 4 is a reasonable
+#: floor for the window sizes the loader moves (>= 8 MiB).
+DEFAULT_CHUNKS = 4
+
+#: Mosaic collective ids (must differ between concurrently-used
+#: collective kernels on a chip).
+_BCAST_COLLECTIVE_ID = 11
+_SCATTER_COLLECTIVE_ID = 12
+
+
+def _bcast_kernel(in_ref, out_ref, send_sem, recv_sem, copy_sem, *,
+                  src: int, n_dev: int, rows: int, n_chunks: int):
+    """Pipelined ring broadcast: source's ``in_ref`` (n_chunks * rows
+    payload rows) lands in every device's ``out_ref`` (payload + one
+    sink chunk).  Grid = (n_chunks + n_dev - 2,) steps; device at ring
+    position p forwards chunk ``clip(t - p)`` at step t."""
+    t = pl.program_id(0)
+    last_t = pl.num_programs(0) - 1
+    me = lax.axis_index(AXIS)
+    pos = lax.rem(me - src + n_dev, n_dev)
+    right = lax.rem(me + 1, n_dev)
+    c_src = jnp.clip(t - pos, 0, n_chunks - 1)
+    # The ring tail's send wraps around to the source; redirect it into
+    # the sink chunk so the live window is never overwritten mid-stream.
+    c_dst = jnp.where(pos == n_dev - 1, n_chunks, c_src)
+
+    # Source: stage chunk t of the window into its own out buffer BEFORE
+    # forwarding it (the send below reads out_ref).
+    @pl.when((pos == 0) & (t < n_chunks))
+    def _stage():
+        cp = pltpu.make_async_copy(
+            in_ref.at[pl.ds(t * rows, rows)],
+            out_ref.at[pl.ds(t * rows, rows)],
+            copy_sem.at[t % 2],
+        )
+        cp.start()
+        cp.wait()  # ddl-lint: disable=DDL012 - device-side DMA semaphore, not a host wait
+
+    def _send_op(step):
+        # One descriptor shape for start and the parity waits: the wait
+        # only consumes semaphore signals sized like one chunk, so the
+        # slice indices of the waited step are irrelevant.
+        return pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[pl.ds(c_src * rows, rows)],
+            dst_ref=out_ref.at[pl.ds(c_dst * rows, rows)],
+            send_sem=send_sem.at[step % 2],
+            recv_sem=recv_sem.at[step % 2],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    op = _send_op(t)
+    op.start()
+    op.wait_recv()
+
+    # Double buffer: only after launching step t's DMA do we drain step
+    # t-1's — chunk N+1 crosses the link while chunk N's wait runs.
+    @pl.when(t >= 1)
+    def _wait_prev():
+        _send_op(t - 1).wait_send()
+
+    @pl.when(t == last_t)
+    def _drain():
+        _send_op(t).wait_send()
+
+
+def _scatter_kernel(in_ref, out_ref, transit, send_sem, recv_sem,
+                    copy_sem, *, src: int, n_dev: int, rows: int):
+    """Pipelined ring scatter: row-block ``b`` of the source's window
+    lands on the device at ring position ``(b - src) % n_dev``.  Blocks
+    are injected farthest-destination-first, so every device's own block
+    arrives exactly at the last step (grid = (n_dev - 1,)).  Transit is
+    a double-buffered VMEM ping-pong; the source's transit half receives
+    the wrap-around garbage and is never read."""
+    s = pl.program_id(0)
+    last_s = pl.num_programs(0) - 1
+    me = lax.axis_index(AXIS)
+    pos = lax.rem(me - src + n_dev, n_dev)
+    right = lax.rem(me + 1, n_dev)
+    par = s % 2        # recv half this step
+    prev = (s + 1) % 2  # send half this step (== recv half of step s-1)
+
+    # Source stages the outgoing block (farthest destination first) into
+    # the send half; destination position p's block is row-block
+    # (src + p) % n_dev of the window.
+    @pl.when(pos == 0)
+    def _stage():
+        blk = lax.rem(src + (n_dev - 1 - s), n_dev)
+        cp = pltpu.make_async_copy(
+            in_ref.at[pl.ds(blk * rows, rows)],
+            transit.at[prev],
+            copy_sem.at[par],
+        )
+        cp.start()
+        cp.wait()  # ddl-lint: disable=DDL012 - device-side DMA semaphore, not a host wait
+
+    def _send_op(step):
+        return pltpu.make_async_remote_copy(
+            src_ref=transit.at[(step + 1) % 2],
+            dst_ref=transit.at[step % 2],
+            send_sem=send_sem.at[step % 2],
+            recv_sem=recv_sem.at[step % 2],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    op = _send_op(s)
+    op.start()
+    op.wait_recv()
+
+    # Every non-source device's own block arrives exactly at the last
+    # step: keep it.
+    @pl.when((pos > 0) & (s == last_s))
+    def _keep():
+        cp = pltpu.make_async_copy(transit.at[par], out_ref, copy_sem.at[prev])
+        cp.start()
+        cp.wait()  # ddl-lint: disable=DDL012 - device-side DMA semaphore, not a host wait
+
+    # The source's own block never travels the ring.
+    @pl.when((pos == 0) & (s == 0))
+    def _own():
+        cp = pltpu.make_async_copy(
+            in_ref.at[pl.ds(src * rows, rows)], out_ref, copy_sem.at[prev]
+        )
+        cp.start()
+        cp.wait()  # ddl-lint: disable=DDL012 - device-side DMA semaphore, not a host wait
+
+    @pl.when(s >= 1)
+    def _wait_prev():
+        _send_op(s - 1).wait_send()
+
+    @pl.when(s == last_s)
+    def _drain():
+        _send_op(s).wait_send()
+
+
+def interpret_default(devices: Sequence[Any]) -> bool:
+    """Interpret (CPU-simulate) unless every ring device is a real TPU."""
+    return any(getattr(d, "platform", "cpu") != "tpu" for d in devices)
+
+
+# -- geometry helpers ---------------------------------------------------------
+
+
+def bcast_grid(n_dev: int, n_chunks: int) -> int:
+    """Broadcast pipeline depth: chunk c reaches ring position p at step
+    p + c - 1, so the tail's last chunk lands at step n_dev + n_chunks - 3."""
+    return n_chunks + n_dev - 2
+
+
+def wire_bytes(mode: str, nbytes: int, n_dev: int,
+               n_chunks: int = DEFAULT_CHUNKS,
+               rows: Optional[int] = None) -> int:
+    """Total bytes the fan-out moves over ICI links (including the
+    clamped edge repeats and the sink-chunk wrap sends) — the honest
+    numerator for link-utilization math.
+
+    Pass ``rows`` (the 2D view's leading dim) when known: the broadcast
+    pads rows up to a chunk multiple and every DMA moves whole padded
+    chunks, so the rowless byte-ceil estimate underprices the wire
+    whenever ``rows % n_chunks != 0``."""
+    if n_dev <= 1:
+        return 0
+    if mode == "replicate":
+        if rows:
+            # ceil(rows/n_chunks) whole rows per chunk-send.
+            chunk = -(-rows // n_chunks) * (nbytes // rows)
+        else:
+            chunk = -(-nbytes // n_chunks)
+        return n_dev * bcast_grid(n_dev, n_chunks) * chunk
+    if mode == "shard":
+        block = nbytes // n_dev
+        return n_dev * (n_dev - 1) * block
+    raise ValueError(f"mode must be replicate|shard, got {mode!r}")
+
+
+def payload_bytes(mode: str, nbytes: int, n_dev: int) -> int:
+    """Bytes usefully *delivered* by the fan-out (what the consumer
+    gains): n-1 windows for replicate, the off-source blocks for shard."""
+    if n_dev <= 1:
+        return 0
+    if mode == "replicate":
+        return (n_dev - 1) * nbytes
+    if mode == "shard":
+        return nbytes - nbytes // n_dev
+    raise ValueError(f"mode must be replicate|shard, got {mode!r}")
+
+
+# -- compiled-call cache ------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_mesh(devices: Tuple[Any, ...]):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices), (AXIS,))
+
+
+@functools.lru_cache(maxsize=64)
+def _bcast_call(devices: Tuple[Any, ...], rows: int, cols: int,
+                dtype_name: str, src: int, n_chunks: int, interpret: bool):
+    """Jitted shard_map'ed broadcast over ``devices``: input global
+    (n * R_pad, cols) P(x) [only the source's block is real], output
+    global (n * (R_pad + rows_per_chunk), cols) P(x) [payload + sink]."""
+    import jax.numpy as jnp  # noqa: F401 - dtype resolution namespace
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(devices)
+    mesh = _ring_mesh(devices)
+    dtype = np.dtype(dtype_name)
+    chunk_rows = rows // n_chunks
+    kern = functools.partial(
+        _bcast_kernel, src=src, n_dev=n_dev, rows=chunk_rows,
+        n_chunks=n_chunks,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(bcast_grid(n_dev, n_chunks),),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))] * 3,
+    )
+    call = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((rows + chunk_rows, cols), dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=_BCAST_COLLECTIVE_ID
+        ),
+    )
+    fn = shard_map(
+        call, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+        check_vma=False,
+    )
+    spec = NamedSharding(mesh, P(AXIS))
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
+@functools.lru_cache(maxsize=64)
+def _scatter_call(devices: Tuple[Any, ...], rows: int, cols: int,
+                  dtype_name: str, src: int, interpret: bool):
+    """Jitted shard_map'ed scatter: input global (n * R, cols) P(x)
+    [source block real], output global (R, cols) P(x) — row-block i on
+    device i."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(devices)
+    mesh = _ring_mesh(devices)
+    dtype = np.dtype(dtype_name)
+    block_rows = rows // n_dev
+    kern = functools.partial(
+        _scatter_kernel, src=src, n_dev=n_dev, rows=block_rows
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n_dev - 1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows, cols), jnp.dtype(dtype)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    call = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((block_rows, cols), dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=_SCATTER_COLLECTIVE_ID
+        ),
+    )
+    fn = shard_map(
+        call, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+        check_vma=False,
+    )
+    spec = NamedSharding(mesh, P(AXIS))
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
+@functools.lru_cache(maxsize=4)
+def _landing_buffers(devices: Tuple[Any, ...], rows: int, cols: int,
+                     dtype_name: str, skip: int):
+    """Per-device landing buffers for the non-source ring slots (the
+    SPMD input needs a block on every device; only the source's carries
+    data).  Cached per geometry so steady-state windows allocate
+    nothing — each entry PINS one window-sized block per non-source
+    device in HBM for the cache's life, which is why (a) the cache is
+    small (a loader cycles a handful of window geometries, not 64) and
+    (b) the redistribution plan prices the landing block into its
+    asserted per-device peak."""
+    zeros = np.zeros((rows, cols), np.dtype(dtype_name))
+    return tuple(
+        None if i == skip else jax.device_put(zeros, d)
+        for i, d in enumerate(devices)
+    )
+
+
+def _as_ring_input(block: Any, devices: Tuple[Any, ...], rows: int,
+                   cols: int, src: int):
+    """Assemble the SPMD global input (n * rows, cols) P(x): the source
+    block plus cached landing buffers — zero host traffic after the
+    first call per geometry."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(devices)
+    dtype_name = np.dtype(block.dtype).name
+    landing = _landing_buffers(devices, rows, cols, dtype_name, src)
+    shards = [landing[i] if i != src else block for i in range(n_dev)]
+    return jax.make_array_from_single_device_arrays(
+        (n_dev * rows, cols),
+        NamedSharding(_ring_mesh(devices), P(AXIS)),
+        shards,
+    )
+
+
+# -- public wrappers ----------------------------------------------------------
+
+
+def fanout_replicate(block: Any, devices: Sequence[Any], src: int = 0,
+                     n_chunks: int = DEFAULT_CHUNKS,
+                     interpret: Optional[bool] = None) -> Any:
+    """Broadcast a (rows, cols) device block to every ring device.
+
+    ``block`` must live on ``devices[src]``.  Returns a global
+    ``(n * rows, cols)`` array sharded one block per device, every block
+    byte-identical to the source (callers reinterpret the shards — see
+    :func:`replicated_view`).  Rows are padded up to a chunk multiple
+    internally and sliced back off.
+    """
+    devices = tuple(devices)
+    n_dev = len(devices)
+    if n_dev == 1:
+        return block
+    if interpret is None:
+        interpret = interpret_default(devices)
+    rows, cols = block.shape
+    n_chunks = max(1, min(n_chunks, rows))
+    pad = (-rows) % n_chunks
+    if pad:
+        block = jnp.pad(block, ((0, pad), (0, 0)))
+    rows_pad = rows + pad
+    gin = _as_ring_input(block, devices, rows_pad, cols, src)
+    call = _bcast_call(
+        devices, rows_pad, cols, np.dtype(block.dtype).name, src,
+        n_chunks, interpret,
+    )
+    out = call(gin)  # (n * (rows_pad + chunk), cols): payload + sink
+    return _strip_blocks(out, devices, rows_pad + rows_pad // n_chunks,
+                         rows)
+
+
+def fanout_shard(block: Any, devices: Sequence[Any], src: int = 0,
+                 interpret: Optional[bool] = None) -> Any:
+    """Scatter a (rows, cols) device block: row-block ``i`` lands on
+    ``devices[(src + ((i - src) % n)) % n]`` — i.e. block i on device i.
+
+    ``rows`` must divide evenly by the ring size (the planner guarantees
+    this or falls back).  Returns a global (rows, cols) array sharded
+    P(x) over the ring.
+    """
+    devices = tuple(devices)
+    n_dev = len(devices)
+    if n_dev == 1:
+        return block
+    if interpret is None:
+        interpret = interpret_default(devices)
+    rows, cols = block.shape
+    if rows % n_dev:
+        raise ValueError(
+            f"shard fan-out needs rows ({rows}) divisible by the ring "
+            f"size ({n_dev})"
+        )
+    gin = _as_ring_input(block, devices, rows, cols, src)
+    call = _scatter_call(
+        devices, rows, cols, np.dtype(block.dtype).name, src, interpret
+    )
+    return call(gin)
+
+
+def _strip_blocks(out: Any, devices: Tuple[Any, ...], block_rows: int,
+                  keep_rows: int) -> Any:
+    """Reassemble a (n * block_rows, cols) P(x) kernel output into the
+    same layout with each block truncated to ``keep_rows`` (drops chunk
+    padding + the sink chunk) — one cached jitted slice per geometry.
+    ``out`` already carries the ring's P(x) NamedSharding (the kernel's
+    declared out_shardings), so it feeds the slice directly."""
+    if block_rows == keep_rows:
+        return out
+    return _strip_call(
+        devices, block_rows, keep_rows, out.shape[1],
+        np.dtype(out.dtype).name,
+    )(out)
+
+
+@functools.lru_cache(maxsize=64)
+def _strip_call(devices: Tuple[Any, ...], block_rows: int, keep_rows: int,
+                cols: int, dtype_name: str):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _ring_mesh(devices)
+    spec = NamedSharding(mesh, P(AXIS))
+
+    def body(x):
+        return x[:keep_rows]
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+        check_vma=False,
+    )
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
+def replicated_view(out: Any, devices: Sequence[Any]) -> Any:
+    """Reinterpret a block-per-device broadcast result (n * rows, cols)
+    as ONE logically-replicated (rows, cols) array — zero-copy: the
+    per-device shards become the replicas."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devices = tuple(devices)
+    n_dev = len(devices)
+    if n_dev == 1:
+        return out
+    rows = out.shape[0] // n_dev
+    shards = sorted(
+        out.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return jax.make_array_from_single_device_arrays(
+        (rows, out.shape[1]),
+        NamedSharding(_ring_mesh(devices), P(None, None)),
+        [s.data for s in shards],
+    )

@@ -1,0 +1,558 @@
+"""ICI ingest tier: device-side fan-out + loader→trainer redistribution.
+
+The layer between the loader and every parallelism axis (ROADMAP item
+1).  A committed window crosses H2D exactly once — onto one *anchor*
+device — and every further hop rides ICI under this module's control:
+
+1. **Fan-out** (:mod:`ddl_tpu.ops.ici_fanout`): a Pallas
+   ``make_async_remote_copy`` ring replicates or shards the anchor's
+   window across a flat device ring (double-buffered DMA pipeline).
+2. **Redistribution**: the ring layout ("split n ways along one dim",
+   ring-ordered) is moved to the trainer's ``dp×fsdp×tp``
+   ``NamedSharding`` as a short sequence of portable, memory-bounded
+   collectives — the ring order is chosen target-major so the only leg
+   ever needed is a tiled ``all_gather`` over the replication axes
+   (following *Memory-efficient array redistribution through portable
+   collective communication*, arXiv:2112.01075: per-axis legs, never an
+   unsharded intermediate).  Peak per-device live bytes — including the
+   ring's window-sized SPMD landing block that every device must hold —
+   are computed in the plan and asserted against ``max_memory_factor``
+   × the window size.
+
+Planning is geometry-cached; steady-state windows dispatch two compiled
+programs (fan-out kernel + finish collective) and allocate nothing on
+the host.  Two fallback rungs to the ``xla`` path — the pre-existing
+``device_put`` scatter: an UNPLANNABLE geometry (ragged batch,
+indivisible split) degrades that geometry only, while a DMA-leg failure
+(or the ``ici.fanout`` chaos site) latches the whole tier off — so the
+degradation ladder covers the new tier (``ici.fallbacks`` counts both
+rungs).
+
+Observability (all flowing into ``north_star_report`` / the bench
+``ici`` block): ``ici.bytes`` (wire bytes the fan-out moved),
+``ici.windows``, ``ici.fallbacks``, ``ici.fanout`` / ``ici.redistribute``
+dispatch timers, and the ``ici.peak_bytes`` gauge (the plan's asserted
+per-device peak).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddl_tpu.exceptions import ShutdownRequested
+from ddl_tpu.faults import fault_point
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+logger = logging.getLogger("ddl_tpu")
+
+#: Redistribution legs may not exceed this multiple of the WINDOW size
+#: in per-device live bytes (the arXiv:2112.01075 discipline: a
+#: bounded-memory plan or no plan).  The accounting includes the SPMD
+#: ring's per-device landing block — shard_map needs an equal-shaped
+#: input block on EVERY ring device, so each non-source device carries
+#: one window-sized (cached, pinned) landing buffer through every leg —
+#: plus the kernel's output and the scatter's double-buffered VMEM
+#: transit.  3.0 is the worst case the shipped legs can construct: a
+#: single-chunk replicate (landing + payload output + sink chunk = 3
+#: windows); every multi-chunk or shard plan sits under it.
+DEFAULT_MEMORY_FACTOR = 3.0
+
+
+class PlanError(ValueError):
+    """The target sharding has no bounded-memory ICI plan (caller falls
+    back to the XLA path)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RedistLeg:
+    """One plan step: what moves, over which axes, at what cost."""
+
+    kind: str  #: "fanout.replicate" | "fanout.shard" | "all_gather" | "reshape"
+    axes: Tuple[str, ...]  #: named mesh axes the leg communicates over
+    ici_bytes: int  #: bytes this leg moves over ICI (wire, per window)
+    peak_bytes: int  #: max per-device live bytes during the leg
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributionPlan:
+    """A geometry's full route from anchor device to target sharding."""
+
+    mode: str  #: "replicate" | "shard"
+    shape: Tuple[int, ...]
+    dtype: Any
+    split_dim: Optional[int]  #: window dim the target shards (None = replicated)
+    split_axes: Tuple[str, ...]  #: mesh axes sharding split_dim (target-major)
+    rest_axes: Tuple[str, ...]  #: replication axes the finish leg gathers
+    ring_devices: Tuple[Any, ...]  #: fan-out ring, target-major order
+    legs: Tuple[RedistLeg, ...]
+    wire_bytes: int  #: total ICI bytes per window
+    payload_bytes: int  #: bytes usefully delivered per window
+    peak_bytes: int  #: max per-device live bytes across legs (incl. landing)
+    dst_shard_bytes: int  #: destination per-device shard size
+    peak_factor: float  #: peak_bytes / window bytes (asserted bound)
+
+    @property
+    def anchor(self):
+        """The device H2D lands on (ring source)."""
+        return self.ring_devices[0]
+
+
+def _split_layout(spec: Any, ndim: int) -> Tuple[Optional[int], Tuple[str, ...]]:
+    """The single (dim, mesh-axes) pair a supported target spec shards,
+    or (None, ()) for full replication.  Raises PlanError on specs the
+    fan-out ring cannot source (more than one sharded dim)."""
+    sharded = []
+    entries = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    for dim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        if axes:
+            sharded.append((dim, axes))
+    if not sharded:
+        return None, ()
+    if len(sharded) > 1:
+        raise PlanError(
+            f"target spec {spec} shards {len(sharded)} dims; the ICI "
+            "fan-out sources a single split dim"
+        )
+    return sharded[0]
+
+
+def _ring_order(mesh: Any, split_axes: Tuple[str, ...],
+                rest_axes: Tuple[str, ...]) -> Tuple[Any, ...]:
+    """Mesh devices flattened target-major (split axes outermost, in
+    spec order): ring block ``i`` then lands exactly where the target
+    layout wants row-block ``i``, so the finish leg is a pure gather
+    over ``rest_axes`` — never a permute."""
+    names = list(mesh.axis_names)
+    order = [names.index(a) for a in split_axes] + [
+        names.index(a) for a in rest_axes
+    ]
+    return tuple(np.transpose(mesh.devices, order).reshape(-1))
+
+
+def plan_distribution(
+    shape: Sequence[int],
+    dtype: Any,
+    sharding: Any,
+    max_memory_factor: float = DEFAULT_MEMORY_FACTOR,
+    n_chunks: Optional[int] = None,
+) -> DistributionPlan:
+    """Plan the anchor→``sharding`` route for one window geometry.
+
+    Raises :class:`PlanError` when no bounded plan exists (unsupported
+    spec shape, split dim not divisible by the device count, or the
+    computed peak exceeding ``max_memory_factor`` × the destination
+    shard) — callers fall back to the XLA path and count it.
+    """
+    from ddl_tpu.ops import ici_fanout
+
+    shape = tuple(int(s) for s in shape)
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    mesh = sharding.mesh
+    spec = sharding.spec
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    split_dim, split_axes = _split_layout(spec, len(shape))
+    rest_axes = tuple(
+        a for a in mesh.axis_names if a not in split_axes
+    )
+    n_chunks = n_chunks or ici_fanout.DEFAULT_CHUNKS
+
+    if split_dim is None:
+        ring = _ring_order(mesh, (), rest_axes)
+        # The kernel clamps the chunk count to the split-dim extent;
+        # mirror it so the plan prices what actually runs.
+        rows = shape[0]
+        n_chunks = max(1, min(n_chunks, rows))
+        wire = ici_fanout.wire_bytes(
+            "replicate", nbytes, n_dev, n_chunks, rows=rows
+        )
+        payload = ici_fanout.payload_bytes("replicate", nbytes, n_dev)
+        # Per-device live: the window-sized SPMD landing block (cached —
+        # every ring device needs an equal-shaped input block) + the
+        # kernel output (the full window, which IS the target, plus the
+        # sink chunk riding along during the kernel).  Chunk = whole
+        # padded rows, matching the kernel's row padding.
+        chunk = -(-rows // n_chunks) * (nbytes // rows)
+        peak = 2 * nbytes + chunk
+        legs = (
+            RedistLeg("fanout.replicate", ("x",), wire, peak),
+        )
+        dst = nbytes
+        plan = DistributionPlan(
+            mode="replicate", shape=shape, dtype=dtype, split_dim=None,
+            split_axes=(), rest_axes=rest_axes, ring_devices=ring,
+            legs=legs, wire_bytes=wire, payload_bytes=payload,
+            peak_bytes=peak, dst_shard_bytes=dst,
+            peak_factor=peak / nbytes,
+        )
+    else:
+        split = shape[split_dim]
+        if split % n_dev:
+            raise PlanError(
+                f"split dim {split_dim} ({split} rows) not divisible by "
+                f"the {n_dev}-device ring"
+            )
+        g = int(np.prod([mesh.shape[a] for a in split_axes]))
+        ring = _ring_order(mesh, split_axes, rest_axes)
+        wire = ici_fanout.wire_bytes("shard", nbytes, n_dev)
+        payload = ici_fanout.payload_bytes("shard", nbytes, n_dev)
+        block = nbytes // n_dev
+        dst = nbytes // g
+        legs: List[RedistLeg] = [
+            # Scatter peak: the window-sized SPMD landing block (cached
+            # on every ring device) + the output block + the kernel's
+            # double-buffered VMEM transit (2 blocks).
+            RedistLeg("fanout.shard", ("x",), wire, nbytes + 3 * block),
+        ]
+        if rest_axes:
+            m = n_dev // g
+            # Tiled all_gather over the replication axes: each device
+            # receives the m-1 sibling blocks of its target shard (the
+            # pinned landing block + kernel output stay live under it).
+            legs.append(
+                RedistLeg(
+                    "all_gather", rest_axes, n_dev * (m - 1) * block,
+                    nbytes + block + dst,
+                )
+            )
+        legs.append(RedistLeg("reshape", (), 0, nbytes + dst))
+        peak = max(leg.peak_bytes for leg in legs)
+        plan = DistributionPlan(
+            mode="shard", shape=shape, dtype=dtype, split_dim=split_dim,
+            split_axes=split_axes, rest_axes=rest_axes, ring_devices=ring,
+            legs=tuple(legs), wire_bytes=wire + (
+                legs[1].ici_bytes if rest_axes else 0
+            ),
+            payload_bytes=payload, peak_bytes=peak, dst_shard_bytes=dst,
+            peak_factor=peak / nbytes,
+        )
+    if plan.peak_factor > max_memory_factor:
+        raise PlanError(
+            f"plan peak {plan.peak_bytes}B is {plan.peak_factor:.2f}x the "
+            f"window ({nbytes}B) — over the "
+            f"{max_memory_factor}x memory bound"
+        )
+    return plan
+
+
+# -- compiled execution pieces (geometry-cached) ------------------------------
+
+
+# Hashable Mesh wrapper for lru_cache keys — the one definition lives
+# with the other mesh-keyed compiled-call caches (importing it here is
+# free: ddl_tpu.parallel.__init__ already loads collectives eagerly).
+from ddl_tpu.parallel.collectives import _MeshKey  # noqa: E402
+
+
+@functools.lru_cache(maxsize=64)
+def _to2d_call(device: Any, shape: Tuple[int, ...], dtype_name: str,
+               split_dim: int):
+    """Anchor-local (split, -1) view builder: moveaxis + reshape, one
+    compiled program per geometry, stays on the anchor device."""
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.sharding.SingleDeviceSharding(device)
+
+    def body(x):
+        return jnp.moveaxis(x, split_dim, 0).reshape(shape[split_dim], -1)
+
+    return jax.jit(body, out_shardings=sds)
+
+
+@functools.lru_cache(maxsize=64)
+def _finish_shard_call(mesh_key: _MeshKey, shape: Tuple[int, ...],
+                       dtype_name: str, split_dim: int,
+                       split_axes: Tuple[str, ...],
+                       rest_axes: Tuple[str, ...]):
+    """The single finish collective for shard mode: gather the
+    replication axes (tiled on the split dim), restore the window's dim
+    order locally, land on the exact target spec."""
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddl_tpu._compat import shard_map
+
+    mesh = mesh_key.mesh
+    other_dims = tuple(
+        d for d in range(len(shape)) if d != split_dim
+    )
+
+    def body(x):  # x: (split_local, flat_features)
+        if rest_axes:
+            x = lax.all_gather(
+                x, rest_axes if len(rest_axes) > 1 else rest_axes[0],
+                axis=0, tiled=True,
+            )
+        import jax.numpy as jnp
+
+        x = x.reshape((x.shape[0],) + tuple(shape[d] for d in other_dims))
+        return jnp.moveaxis(x, 0, split_dim)
+
+    in_spec = P(tuple(split_axes) + tuple(rest_axes), None)
+    out_entries: List[Any] = [None] * len(shape)
+    out_entries[split_dim] = tuple(split_axes)
+    out_spec = P(*out_entries)
+    fn = shard_map(
+        body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_vma=False,
+    )
+    return jax.jit(
+        fn,
+        in_shardings=NamedSharding(mesh, in_spec),
+        out_shardings=NamedSharding(mesh, out_spec),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _finish_replicate_call(mesh_key: _MeshKey, shape: Tuple[int, ...],
+                           dtype_name: str):
+    """Replicated 2D view → the window's original shape, landed on the
+    target mesh's fully-replicated sharding (local reshape per device)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh_key.mesh
+    sharding = NamedSharding(mesh, P(*([None] * len(shape))))
+    return jax.jit(
+        lambda x: x.reshape(shape), out_shardings=sharding
+    )
+
+
+class IciDistributor:
+    """Executes :func:`plan_distribution` routes for one target sharding.
+
+    Geometry plans (and their compiled programs) are cached.  Two
+    fallback rungs, scoped to match their causes:
+
+    - **Per-geometry** — a shape with no bounded plan (ragged final
+      batch, indivisible split) takes the XLA scatter for THAT geometry
+      only, counted once at plan time; plannable geometries keep riding
+      ICI.
+    - **Tier-wide latch** — a failed DMA leg (or the ``ici.fanout``
+      chaos site) sets ``faulted`` and every later window takes the XLA
+      fallback — the chip keeps training while the bench/report shows
+      ``ici.fallbacks`` ticking.  The first window of each geometry is
+      synchronized (``block_until_ready``) inside the ladder's
+      try/except, because on real TPUs dispatch is async and a bring-up
+      DMA failure would otherwise surface at the CONSUMER's sync point,
+      outside the ladder; steady-state windows stay async.  A mid-stream
+      link failure on already-validated geometry still surfaces
+      downstream — that rung is the trainer's existing failure path, not
+      this latch.
+    """
+
+    def __init__(
+        self,
+        sharding: Any,
+        metrics: Optional[Metrics] = None,
+        interpret: Optional[bool] = None,
+        max_memory_factor: float = DEFAULT_MEMORY_FACTOR,
+        n_chunks: Optional[int] = None,
+    ):
+        self.sharding = sharding
+        self.metrics = metrics or default_metrics()
+        self.interpret = interpret
+        self.max_memory_factor = max_memory_factor
+        self.n_chunks = n_chunks
+        self.faulted = False
+        self._mesh_key = _MeshKey(sharding.mesh)
+        # geometry -> DistributionPlan | PlanError; windows recur over a
+        # handful of geometries, and a failed plan must not be re-derived
+        # (nor re-logged, nor re-counted) per window.  Bounded: 8
+        # geometries LRU.
+        self._plans: "dict" = {}
+        # Geometries whose FIRST window completed a synchronized
+        # dispatch — later windows skip the block_until_ready.
+        self._validated: set = set()
+        # Unplannable geometries already logged + counted: the LRU can
+        # evict and re-derive their PlanError, but ``ici.fallbacks``
+        # must tick once per geometry, not once per re-derivation.
+        self._counted_failures: set = set()
+
+    def plan(self, shape: Sequence[int], dtype: Any) -> DistributionPlan:
+        key = (tuple(int(s) for s in shape), np.dtype(dtype).name)
+        # pop + re-insert marks recency (dict preserves insertion
+        # order), so the hot per-window geometry is never the one
+        # evicted by a burst of rare put_batch shapes.
+        hit = self._plans.pop(key, None)
+        if hit is None:
+            try:
+                hit = plan_distribution(
+                    key[0], key[1], self.sharding,
+                    max_memory_factor=self.max_memory_factor,
+                    n_chunks=self.n_chunks,
+                )
+            except PlanError as e:
+                hit = e
+                # Counted + logged ONCE per geometry for the
+                # distributor's life (NOT per cache insert — the LRU
+                # may evict and re-derive a PlanError): this geometry
+                # rides the xla scatter, the tier stays up for
+                # plannable ones.
+                if key not in self._counted_failures:
+                    self._counted_failures.add(key)
+                    logger.warning(
+                        "ddl_tpu: no bounded ICI plan for %s/%s (%s) — "
+                        "this geometry takes the xla path",
+                        key[0], key[1], e,
+                    )
+                    self.metrics.incr("ici.fallbacks")
+            if len(self._plans) >= 8:
+                self._plans.pop(next(iter(self._plans)))
+        self._plans[key] = hit
+        if isinstance(hit, PlanError):
+            raise hit
+        return hit
+
+    def anchor(self, shape: Sequence[int], dtype: Any) -> Any:
+        """The device H2D must land on for this geometry."""
+        return self.plan(shape, dtype).anchor
+
+    def put(self, arr: Any, device_put: Any) -> Any:
+        """The ingest seam's one-call entry: H2D ``arr`` onto the plan's
+        anchor device with ``device_put``, then distribute over ICI.  A
+        geometry with no bounded plan takes one XLA-scattered put for
+        that geometry instead — the seam sees exactly the exceptions the
+        plain xla path would raise, never an ICI-specific one."""
+        if not self.faulted:
+            try:
+                anchor = self.plan(arr.shape, arr.dtype).anchor
+            except PlanError:
+                pass  # counted+logged once in plan(); per-geometry xla
+            else:
+                return self.distribute(device_put(arr, anchor))
+        return device_put(arr, self.sharding)
+
+    def distribute(self, block: Any) -> Any:
+        """Move an anchor-resident window to the target sharding over
+        ICI.  An unplannable geometry re-routes through the XLA path
+        (that geometry only); any fan-out execution failure (including
+        the ``ici.fanout`` chaos site) re-routes AND latches the
+        fallback for the rest of the distributor's life."""
+        if self.faulted:
+            return self._xla_fallback(block)
+        try:
+            plan = self.plan(block.shape, block.dtype)
+        except PlanError:
+            return self._xla_fallback(block)
+        try:
+            return self._distribute_planned(block, plan)
+        except (ShutdownRequested, KeyboardInterrupt):
+            raise  # a shutdown is not a DMA failure — never latch on it
+        except Exception as e:  # noqa: BLE001 - ladder rung, re-routed
+            self._latch(f"{type(e).__name__}: {e}")
+            return self._xla_fallback(block)
+
+    def _distribute_planned(self, block: Any, plan: DistributionPlan) -> Any:
+        import time
+
+        from ddl_tpu.ops import ici_fanout
+
+        fault_point("ici.fanout")
+        m = self.metrics
+        dtype_name = np.dtype(block.dtype).name
+        t0 = time.perf_counter()
+        if plan.mode == "replicate":
+            flat = _to2d_call(
+                plan.anchor, plan.shape, dtype_name, 0
+            )(block)
+            out = ici_fanout.fanout_replicate(
+                flat, plan.ring_devices, src=0,
+                n_chunks=self.n_chunks or ici_fanout.DEFAULT_CHUNKS,
+                interpret=self.interpret,
+            )
+            m.add_time("ici.fanout", time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            rep = ici_fanout.replicated_view(out, plan.ring_devices)
+            result = _finish_replicate_call(
+                self._mesh_key, plan.shape, dtype_name
+            )(rep)
+            m.add_time("ici.redistribute", time.perf_counter() - t1)
+        else:
+            flat = _to2d_call(
+                plan.anchor, plan.shape, dtype_name, plan.split_dim
+            )(block)
+            out = ici_fanout.fanout_shard(
+                flat, plan.ring_devices, src=0, interpret=self.interpret
+            )
+            m.add_time("ici.fanout", time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            result = _finish_shard_call(
+                self._mesh_key, plan.shape, dtype_name, plan.split_dim,
+                plan.split_axes, plan.rest_axes,
+            )(self._onto_mesh(out, plan))
+            m.add_time("ici.redistribute", time.perf_counter() - t1)
+        key = (plan.shape, np.dtype(plan.dtype).name)
+        if key not in self._validated:
+            # First window of a geometry: synchronize so that a
+            # bring-up DMA failure — asynchronous on real TPUs, where
+            # dispatch returns before the ring kernel runs — surfaces
+            # HERE, inside distribute()'s try/except, and latches the
+            # xla fallback instead of stranding the consumer's
+            # block_until_ready.  Steady-state windows stay async.
+            import jax
+
+            jax.block_until_ready(result)
+            self._validated.add(key)
+        m.incr("ici.bytes", float(plan.wire_bytes))
+        m.incr("ici.windows")
+        m.set_gauge("ici.peak_bytes", float(plan.peak_bytes))
+        return result
+
+    def _onto_mesh(self, ring_out: Any, plan: DistributionPlan) -> Any:
+        """Zero-copy reinterpretation of the ring's block-per-device
+        output as a trainer-mesh global array (split dim sharded over
+        every axis, target-major) — the finish collective's input."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(tuple(plan.split_axes) + tuple(plan.rest_axes), None)
+        sharding = NamedSharding(self.sharding.mesh, spec)
+        by_device = {s.device: s.data for s in ring_out.addressable_shards}
+        order = sharding.addressable_devices_indices_map(ring_out.shape)
+        return jax.make_array_from_single_device_arrays(
+            ring_out.shape, sharding,
+            [by_device[d] for d in order],
+        )
+
+    def _latch(self, why: str) -> None:
+        if not self.faulted:
+            logger.error(
+                "ddl_tpu: ICI distribution failed (%s) — latched "
+                "fallback to the xla path", why,
+            )
+        self.faulted = True
+        self.metrics.incr("ici.fallbacks")
+
+    def _xla_fallback(self, block: Any) -> Any:
+        """The pre-ICI behavior: let XLA scatter from the anchor."""
+        import jax
+
+        return jax.device_put(block, self.sharding)
+
+
+#: The loader→trainer sharding pairs the dryrun/property tests cover on
+#: the 8-device virtual mesh: every trainer layout the repo's examples
+#: use, from pure dp to dp×fsdp×tp, batch-dim and leading-dim splits,
+#: plus full replication.  (mesh axes, target spec entries) — specs are
+#: built per-test so the module stays importable without jax devices.
+DRYRUN_MATRIX: Tuple[Tuple[Tuple[Tuple[str, int], ...], Tuple[Any, ...]], ...] = (
+    ((("dp", 8),), ("dp", None)),
+    ((("dp", 8),), (None, "dp")),
+    ((("dp", 4), ("fsdp", 2)), (None, "dp")),
+    ((("dp", 4), ("fsdp", 2)), (("dp", "fsdp"), None)),
+    ((("dp", 2), ("fsdp", 2), ("tp", 2)), (None, "dp")),
+    ((("dp", 2), ("fsdp", 2), ("tp", 2)), (("dp", "fsdp"), None)),
+    ((("dp", 2), ("fsdp", 4)), (None, None)),
+    ((("dp", 8),), (None, "dp", None)),
+)

@@ -199,6 +199,9 @@ class TestNorthStarReport:
             # training hot-path extras (ISSUE 5: overlap health +
             # pipeline-schedule gauges)
             "window_wait_s", "release_wait_s", "pp_bubble", "pp_chunks",
+            # ICI ingest tier extras (ISSUE 7: ddl_tpu/parallel/ici)
+            "ici_bytes", "ici_windows", "ici_fallbacks",
+            "ici_fanout_s", "ici_redistribute_s", "ici_peak_bytes",
         }
         assert r["samples_per_sec"] > 0
 

@@ -164,6 +164,15 @@ VIOLATIONS = {
                 perm = self._rng.permutation(len(arr))
                 np.copyto(my_ary, arr[perm])   # fancy-index temp + copy
     """,
+    "DDL016": """
+        import jax
+        import numpy as np
+
+        class IciDistributor:
+            def distribute(self, block):
+                host = jax.device_get(block)   # D2H round-trip per window
+                return self._fan_out(host)
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -338,6 +347,18 @@ CLEAN = {
         def host_side(my_ary, arr, perm):
             np.copyto(my_ary, arr[perm])       # not a fill function
     """,
+    "DDL016": """
+        import jax
+        import numpy as np
+
+        class IciDistributor:
+            def distribute(self, block):
+                plan = self.plan(block.shape, np.dtype(block.dtype))
+                return self._fan_out(block, plan)   # stays on device
+
+        def debug_dump(block):
+            return np.asarray(block)   # not a distribution path
+    """,
 }
 
 
@@ -455,6 +476,46 @@ class TestSelfTest:
         cfg = LintConfig(producer_fill_functions=["CustomProducer._fill"])
         findings = lint_snippet(tmp_path, "DDL015", src, config=cfg)
         assert [f.code for f in findings] == ["DDL015"]
+
+    def test_ddl016_asarray_and_bound_device_get_fire(self, tmp_path):
+        """Both host-round-trip spellings: a blocking np.asarray
+        materialization and device_get through a bound jax handle
+        (self._jax.device_get — how framework classes hold jax)."""
+        src = """
+            import numpy as np
+
+            class IciDistributor:
+                def _onto_mesh(self, ring_out, plan):
+                    shards = [np.asarray(s.data)     # host materialize
+                              for s in ring_out.addressable_shards]
+                    return self._assemble(shards, plan)
+
+                def put(self, arr, device_put):
+                    block = device_put(arr, self.anchor)
+                    return self._jax.device_get(block)   # D2H fetch
+        """
+        findings = lint_snippet(tmp_path, "DDL016", src)
+        assert [f.code for f in findings] == ["DDL016", "DDL016"]
+        assert "asarray" in findings[0].message
+        assert "device_get" in findings[1].message
+
+    def test_ddl016_respects_configured_device_path_list(self, tmp_path):
+        """A function outside device_path_functions stays clean — the
+        check is repo policy (config'd hot list), not a global ban on
+        device_get."""
+        src = """
+            import jax
+
+            class CustomTier:
+                def spread(self, block):
+                    return jax.device_get(block)
+        """
+        cfg = LintConfig(device_path_functions=["OtherTier.spread"])
+        findings = lint_snippet(tmp_path, "DDL016", src, config=cfg)
+        assert findings == [], findings
+        cfg = LintConfig(device_path_functions=["CustomTier.spread"])
+        findings = lint_snippet(tmp_path, "DDL016", src, config=cfg)
+        assert [f.code for f in findings] == ["DDL016"]
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

@@ -4,7 +4,7 @@ JSON contract.
 CI-grade guard for the bench itself (`make bench-smoke` / `make check`):
 the full bench is too slow for per-PR runs, but its JSON line is an
 interface — round 2 shipped a bench whose output silently lost fields.
-Two passes:
+Three passes:
 
 1. `DDL_BENCH_MODE=ingest` with a small window/batch geometry — the
    last stdout line must parse as JSON and carry the staged-ingest
@@ -16,7 +16,13 @@ Two passes:
    measurement in bench.py), `ingest.process_vs_thread >= 0.9` OR the
    `ingest.core_attach` record proves core starvation, and a non-TPU
    run embeds the `last_tpu_artifact` trail (+ `git_head`).
-2. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
+2. `DDL_BENCH_MODE=ici` — the device-side distribution A/B block must
+   carry its contract keys (`bytes_per_s`, `bandwidth_utilization`,
+   `vs_xla`, `byte_identical`, ...), the ICI-distributed window must be
+   byte-identical to the xla path, and the recorded winner must be the
+   faster of the two paths the same run measured (the ici-vs-xla pair
+   rides the ingest headline's never-slower invariant).
+3. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
    schedule/bubble gauges) and its `pipeline_overhead` against the
    matched no-loader ceiling must be <= PIPELINE_OVERHEAD_MAX.  The
@@ -92,6 +98,17 @@ REQUIRED_CACHE = (
 #: factor (ISSUE 4 acceptance; the measured margin is ~40x on the
 #: default 20 ms-latency geometry, so 2.0 is noise-proof).
 MIN_WARM_VS_COLD = 2.0
+#: The ici block's contract (ISSUE 7: DDL_BENCH_MODE=ici — the
+#: device-side distribution A/B).  ``bytes_per_s`` must be the WINNER
+#: of the ici-vs-xla pair (never-headline-slower), ``byte_identical``
+#: must hold (the fan-out may never change bytes), and the utilization
+#: keys must be present even off-TPU (null denominator, 0.0 ratio).
+REQUIRED_ICI = (
+    "bytes_per_s", "bandwidth_utilization", "vs_xla", "byte_identical",
+    "winner", "ici_bytes_per_s", "xla_bytes_per_s",
+    "link_spec_bytes_per_s", "wire_bytes_per_s", "per_hop_bytes_per_s",
+    "peak_factor", "fallbacks", "n_devices", "interpret",
+)
 
 
 def _run_bench(mode: str) -> "dict | None":
@@ -254,7 +271,61 @@ def main() -> int:
                 "over the throttled backend"
             )
             return 1
-    # -- pass 2: the training hot path (ISSUE 5) -----------------------
+    # -- pass 2: the ICI distribution A/B (ISSUE 7) --------------------
+    ici_result = _run_bench("ici")
+    if ici_result is None:
+        return 1
+    ici = ici_result.get("ici")
+    if not isinstance(ici, dict):
+        print(json.dumps(ici_result, indent=1))
+        print(
+            "bench-smoke: no ici block "
+            f"(errors={ici_result.get('errors')})"
+        )
+        return 1
+    ici_missing = [k for k in REQUIRED_ICI if k not in ici]
+    if ici_missing:
+        print(json.dumps(ici, indent=1))
+        print(f"bench-smoke: ici block missing keys: {ici_missing}")
+        return 1
+    if ici["byte_identical"] is not True:
+        print(json.dumps(ici, indent=1))
+        print(
+            "bench-smoke: ICI-distributed window NOT byte-identical to "
+            "the xla path — the fan-out changed data"
+        )
+        return 1
+    # The ici-vs-xla winner rides the same never-headline-slower
+    # invariant as the ingest configs: the mode's headline must be the
+    # faster of the two paths the same run measured, and the recorded
+    # winner label must match it.
+    pair = {"ici": ici["ici_bytes_per_s"], "xla": ici["xla_bytes_per_s"]}
+    if ici["bytes_per_s"] < max(pair.values()):
+        print(json.dumps(ici, indent=1))
+        print(
+            f"bench-smoke: ici headline {ici['bytes_per_s']} is slower "
+            f"than a path the same run measured ({pair}) — never-slower "
+            "invariant violated"
+        )
+        return 1
+    if ici["winner"] != max(pair, key=pair.get) or (
+        ici_result.get("headline_config") != ici["winner"]
+    ):
+        print(json.dumps(ici, indent=1))
+        print(
+            f"bench-smoke: ici winner label {ici['winner']!r} / "
+            f"headline_config {ici_result.get('headline_config')!r} do "
+            f"not name the measured winner ({pair})"
+        )
+        return 1
+    if ici["fallbacks"]:
+        print(json.dumps(ici, indent=1))
+        print(
+            "bench-smoke: ici A/B latched the xla fallback "
+            f"({ici['fallbacks']} times) — the ici timings are not real"
+        )
+        return 1
+    # -- pass 3: the training hot path (ISSUE 5) -----------------------
     overheads = []
     for attempt in range(1, FIT_ATTEMPTS + 1):
         train = _run_bench("train")
@@ -302,7 +373,9 @@ def main() -> int:
         f"(starved={ing.get('core_attach', {}).get('starved')}); "
         "staging + robustness extras present; cache warm/cold "
         f"{cache.get('warm_vs_cold') if isinstance(cache, dict) else '?'}x "
-        "byte-identical; fit_stream overhead "
+        "byte-identical; ici winner "
+        f"{ici['winner']} vs_xla {ici['vs_xla']} byte-identical; "
+        "fit_stream overhead "
         f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "
         f"(window_wait_s={fit['window_wait_s']})"
     )

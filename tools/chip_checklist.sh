@@ -13,13 +13,20 @@
 #   3. bench   - python bench.py (full: ingest+train+fit+sweep+decode)
 #   4. big     - DDL_BENCH_MODE=big python bench.py (HBM-filling MFU)
 #   4b. decode - DDL_BENCH_MODE=decode (serving prefill+decode, MBU)
+#   5. stream  - window-size sweep; ALSO the pending PROCESS-stream
+#                re-measure with alias staging engaged (ROADMAP item 5:
+#                the r05 0.15-utilization leg predates shm-backed
+#                staging, which only activates on accelerators)
+#   6. ici     - fan-out kernel probe (real remote DMA) + the
+#                DDL_BENCH_MODE=ici distribution A/B (per-hop bytes/s,
+#                ICI link utilization, ici-vs-xla)
 set -u
 cd "$(dirname "$0")/.."
 ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/5] probe =="
+echo "== [1/6] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -29,28 +36,32 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/5] on-chip test suite =="
+echo "== [2/6] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/5] full bench =="
+echo "== [3/6] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/5] big-model MFU bench =="
+echo "== [4/6] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
 
-echo "== [4b/5] serving decode bench (small + big, MBU-graded) =="
+echo "== [4b/6] serving decode bench (small + big, MBU-graded) =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
   2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
-echo "== [5/5] stream-bandwidth diagnosis + window-size sweep =="
+echo "== [5/6] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
 # fail loudly (step timeout), never silently record CPU numbers in a
 # TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
 # (plus the link measure) — the non-stream ingest configs don't depend
-# on the window size and step 3 already measured them.
+# on the window size and step 3 already measured them.  These legs are
+# ALSO the pending ROADMAP-item-5 re-measure: the stream_process leg
+# now runs with shm-backed alias staging engaged (accelerator-only
+# path, DDL_TPU_SHM_STAGING default on), which the r05 0.15-utilization
+# artifact predates — compare ingest_stream_process against it.
 DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_stream.py 32 \
   2>&1 | tee "$ART/stream-probe-32-$STAMP.txt" | tail -8
 for MIB in 64 128; do
@@ -60,5 +71,16 @@ for MIB in 64 128; do
     2> "$ART/bench-stream-$MIB-$STAMP.err" \
     | tee "$ART/bench-stream-$MIB-$STAMP.json"
 done
+
+echo "== [6/6] ICI fan-out probe + distribution A/B =="
+# Real remote-DMA numbers for the device-side distribution tier
+# (ddl_tpu/parallel/ici.py): per-hop bytes/s from the kernel probe,
+# then the ici-vs-xla A/B with link utilization against the per-link
+# spec.  Multi-device only — on a single-chip attach both report the
+# device shortage and move on.
+DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_ici.py \
+  2>&1 | tee "$ART/ici-probe-$STAMP.txt" | tail -8
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici timeout 1200 python bench.py \
+  2> "$ART/bench-ici-$STAMP.err" | tee "$ART/bench-ici-$STAMP.json"
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="

@@ -3,6 +3,7 @@
 from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     caches,
     concurrency,
+    device_path,
     ingest_path,
     jax_hazards,
     producer_fill,

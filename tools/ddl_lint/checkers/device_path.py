@@ -1,0 +1,89 @@
+"""Device-distribution hazards: host round-trips on the ICI tier.
+
+The whole point of the device-side distribution tier
+(``ddl_tpu/parallel/ici.py``) is that a window crosses the host→device
+boundary ONCE — every further hop rides ICI.  A ``jax.device_get`` or a
+blocking ``np.asarray``/``np.array`` materialization inside that tier
+quietly reintroduces a D2H+H2D round-trip per window (and a host sync
+that stalls the whole dispatch pipeline), turning the fan-out into a
+slower spelling of the scatter it replaced.  This checker makes that a
+lint failure instead of a bandwidth regression hunted on a chip.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import dotted_name
+
+
+@register
+class DevicePathHostRoundTrip(Checker):
+    """DDL016: no host round-trips in device-distribution hot paths.
+
+    Functions named in ``[tool.ddl_lint] device_path_functions`` (bare
+    names or ``Class.method``) move device-resident windows between
+    devices.  Inside them, flag:
+
+    - ``jax.device_get(...)`` (any attribute spelling ending in
+      ``device_get``) — an explicit D2H fetch,
+    - ``np.asarray(...)`` / ``np.array(...)`` — a blocking host
+      materialization; on a device array this is ``device_get`` with
+      extra steps, and the redistribution planner must never round-trip
+      through the host.
+
+    Escape hatch: ``# ddl-lint: disable=DDL016`` with a rationale (a
+    debug-only dump helper would be one).
+    """
+
+    code = "DDL016"
+    summary = "host round-trip in a device-distribution hot path"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_hot(node):
+            self._check_body(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_hot(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "device_path_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_body(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if node is fn or not isinstance(node, ast.Call):
+                continue
+            # Nested defs stay in scope on purpose: a closure built in a
+            # distribution path runs at the same per-window cadence.
+            hit = self._classify(node)
+            if hit:
+                self.report(
+                    node,
+                    f"{hit} in device-distribution path "
+                    f"{fn.name}();"  # type: ignore[attr-defined]
+                    " the window must stay on device end to end —"
+                    " keep the hop on ICI or pragma-disable with a"
+                    " rationale",
+                )
+
+    def _classify(self, node: ast.Call) -> Optional[str]:
+        dotted = dotted_name(node.func) or ""
+        seg = dotted.rsplit(".", 1)[-1]
+        # Any spelling of device_get: jax.device_get, self._jax.device_get.
+        if seg == "device_get":
+            return f"{dotted}(...)"
+        # Anchored to the ROOT segment like DDL011: a substring test
+        # would flag attribute chains merely containing "np".
+        if seg in ("asarray", "array") and dotted.split(".", 1)[0] in (
+            "np", "numpy"
+        ):
+            return f"{dotted}(...)"
+        return None

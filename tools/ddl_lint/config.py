@@ -40,6 +40,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL013",  # unbounded module/instance-level dict cache (no eviction)
     "DDL014",  # jax.checkpoint/remat without an explicit policy
     "DDL015",  # materialize-then-copy into the producer window view
+    "DDL016",  # host round-trip in a device-distribution hot path
 )
 
 
@@ -84,6 +85,22 @@ class LintConfig:
             "TokenStreamProducer._fill",
             "PackedTokenProducer._fill",
             "TFRecordTokenProducer._fill",
+        ]
+    )
+    #: Device-distribution functions (bare name or ``Class.method``)
+    #: moving device-resident windows between devices (the ICI tier):
+    #: ``jax.device_get`` / blocking ``np.asarray`` host round-trips
+    #: inside them are DDL016 (the hop must stay on ICI).
+    device_path_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "IciDistributor.put",
+            "IciDistributor.distribute",
+            "IciDistributor._distribute_planned",
+            "IciDistributor._onto_mesh",
+            "fanout_replicate",
+            "fanout_shard",
+            "replicated_view",
+            "_as_ring_input",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -245,6 +262,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.producer_fill_functions = str_list(
         "producer_fill_functions", cfg.producer_fill_functions
+    )
+    cfg.device_path_functions = str_list(
+        "device_path_functions", cfg.device_path_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
