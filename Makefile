@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun check chaos
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test check chaos
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -41,6 +41,19 @@ ici-bench:
 ici-dryrun:
 	$(PY) tools/probe_ici.py
 
+# Distributed-optimizer A/B (zero1 vs replicated state, fp32 vs int8
+# grad comm; docs/PERF_NOTES.md "Distributed optimizer").  Loss parity
+# asserted in the artifact; winner is the headline.
+opt-bench:
+	DDL_BENCH_MODE=opt $(PY) bench.py
+
+# Optimizer-state/grad-comm sweep on whatever devices exist (the CPU
+# virtual mesh elsewhere): measured bytes/replica + leg times at small
+# scale, analytic v5e-32 pricing for the 8B/4B configs — the mirror of
+# tools/probe_ici.py for the optimizer tier.
+opt-dryrun:
+	$(PY) tools/probe_opt.py
+
 # The one-shot local gate: static analysis + bench JSON contract (the
 # bench-smoke contract includes the cache block's byte-identity and
 # >=2x warm-vs-cold assertions).
@@ -52,3 +65,8 @@ check: lint bench-smoke
 # DMA-failure → xla-fallback rung (tests/test_ici.py).
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py -q
+
+# Distributed-optimizer suite alone (parity matrix, collective units,
+# the 4B fits-only-with-zero1 accounting test).
+opt-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_optimizer.py -q

@@ -723,12 +723,32 @@ def _run_train(platform: str, attn_impl: str, size: str = "small"):
 
     cfg, batch, seq, steps = _train_config(platform, size)
     cfg = type(cfg)(**{**cfg.__dict__, "attn_impl": attn_impl})
-    mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
+    # Distributed-optimizer knobs ride the standard TrainConfig env
+    # (DDL_TPU_TRAIN_OPTIMIZER_SHARDING=zero1 / _GRAD_COMM=int8 — the
+    # chip_checklist step-7 train_big re-measure).  zero1 needs a dp
+    # axis: with it requested AND a multi-device attach, the mesh spans
+    # every local device (the batch dp-shards with it); the default
+    # stays the single-chip dp=1 geometry of every prior BENCH_* line.
+    import math
+
+    from ddl_tpu.config import TrainConfig
+
+    tc = TrainConfig.load()
+    # The dp extent must divide the batch (P(("dp",)) shards its leading
+    # axis) — clamp to the gcd so a batch-4 config on a v5e-8 attach
+    # runs dp=4 over 4 chips instead of crashing in _reshard.
+    n_dp = (
+        math.gcd(len(jax.local_devices()), batch)
+        if tc.optimizer_sharding == "zero1"
+        else 1
+    )
+    mesh = make_mesh({"dp": n_dp}, devices=jax.local_devices()[:n_dp])
     # mesh=None for the loss: single-chip attention needs no shard_map (and
     # a dp=1 mesh would only trigger the replicated-attention warning path).
     init_fn, multi_fn = make_multistep(
         lambda p, b: llama.next_token_loss(p, b[0], cfg, mesh=None),
         optax.adamw(3e-4), mesh, llama.param_specs(cfg), n_steps=steps,
+        **tc.optimizer_kwargs(),
     )
     rng = np.random.default_rng(0)
     batch_tokens = (rng.integers(0, cfg.vocab, (batch, seq)).astype(np.int32),)
@@ -769,6 +789,9 @@ def _run_train(platform: str, attn_impl: str, size: str = "small"):
         "attn_impl": attn_impl,
         "size": size,
         "remat": _resolve_remat(cfg.remat),
+        "optimizer_sharding": tc.optimizer_sharding,
+        "grad_comm": tc.grad_comm,
+        "dp": n_dp,
         "params_billions": round(n_params / 1e9, 3),
         "tokens_per_sec": round(tokens_per_step / dt, 1),
         "step_time_ms": round(dt * 1e3, 2),
@@ -1436,6 +1459,196 @@ def _run_ici_ab(platform: str) -> dict:
     return _gate_utilization(block, "ici per-hop")
 
 
+# -- distributed-optimizer A/B ------------------------------------------------
+
+
+def _opt_mesh_axes(n_dev: int) -> dict:
+    """The opt A/B mesh shape for ``n_dev`` devices: dp × fsdp=2 when a
+    2-way fsdp axis fits (so zero1 is exercised COMPOSED with fsdp, the
+    acceptance shape), else all-dp.  Shared with tools/probe_opt.py so
+    the probe's printed numbers describe the same layout the A/B
+    artifact gates on."""
+    fsdp = 2 if n_dev >= 4 and n_dev % 2 == 0 else 1
+    return {"dp": n_dev // fsdp, "fsdp": fsdp}
+
+
+def _opt_config():
+    """The opt A/B model geometry: big enough that the optimizer update
+    and its collectives are a visible step fraction, small enough for
+    the CPU virtual mesh.  DDL_BENCH_OPT_* knobs shrink/grow it.
+    Shared with tools/probe_opt.py (same desync rationale as
+    :func:`_opt_mesh_axes`)."""
+    from ddl_tpu.models.llama import LlamaConfig
+
+    d = int(os.environ.get("DDL_BENCH_OPT_DMODEL", "256"))
+    layers = int(os.environ.get("DDL_BENCH_OPT_LAYERS", "4"))
+    return (
+        LlamaConfig(
+            vocab=2048, d_model=d, n_layers=layers, n_heads=8,
+            n_kv_heads=4, d_ff=4 * d, max_seq=256,
+        ),
+        int(os.environ.get("DDL_BENCH_OPT_BATCH", "8")),
+        int(os.environ.get("DDL_BENCH_OPT_SEQ", "256")),
+        int(os.environ.get("DDL_BENCH_OPT_STEPS", "8")),
+    )
+
+
+def _run_opt_ab(platform: str) -> dict:
+    """The distributed-optimizer A/B (ROADMAP item 2 / ISSUE 8): one
+    llama multistep trained three ways on a dp×fsdp mesh — replicated
+    optimizer state, ZeRO-1 (``parallel.optimizer.ShardedOptimizer``),
+    and ZeRO-1 + int8 grad comm — INTERLEAVED best-of timing, with the
+    loss-curve-parity gate asserted in the artifact.
+
+    Contract (bench_smoke enforces): ``tokens_per_sec`` is the WINNER of
+    the zero1-vs-replicated pair (never-headline-slower invariant);
+    ``loss_parity`` must be true (fp32 zero1 is bit-exact vs replicated
+    — any drift is a correctness bug, not noise); ``int8_parity`` holds
+    the quantized path inside ``parity_rel_tol``;
+    ``state_bytes_per_replica`` must shrink vs ``state_bytes_replicated``
+    (~dp×); ``grad_comm_bytes_quantized`` < ``grad_comm_bytes_raw``.
+    """
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.models import llama
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.parallel.mesh import make_mesh
+    from ddl_tpu.parallel.optimizer import (
+        PARITY_REL_TOL,
+        ShardedOptimizer,
+        loss_parity,
+        state_bytes_per_replica,
+        _tree_bytes,
+    )
+    from ddl_tpu.parallel.train import make_multistep
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    axes = _opt_mesh_axes(n_dev)
+    dp, fsdp = axes["dp"], axes["fsdp"]
+    if dp < 2:
+        raise RuntimeError(
+            f"opt A/B needs a dp axis >= 2, found {n_dev} device(s)"
+        )
+    mesh = make_mesh(axes, devices=devices)
+    cfg, batch, seq, steps = _opt_config()
+    specs = llama.param_specs(cfg)
+    loss_fn = lambda p, b: llama.next_token_loss(p, b[0], cfg)  # noqa: E731
+    rng = np.random.default_rng(0)
+    tokens = (rng.integers(0, cfg.vocab, (batch, seq)).astype(np.int32),)
+    params = llama.init_params(cfg, jax.random.key(0))
+    reps = int(os.environ.get("DDL_BENCH_OPT_REPS", "3"))
+
+    m = Metrics()
+    base = optax.adamw(3e-4)
+    zopt = ShardedOptimizer(base, mesh, specs)
+    qopt = ShardedOptimizer(base, mesh, specs, grad_comm="int8")
+
+    from ddl_tpu.observability import metrics as default_metrics
+
+    variants = {}
+    for name, opt in (
+        ("replicated", base), ("zero1", zopt), ("int8", qopt),
+    ):
+        init_fn, multi_fn = make_multistep(
+            loss_fn, opt, mesh, specs, batch_spec=P(("dp",)),
+            n_steps=steps,
+        )
+        state = init_fn(params)
+        state_bytes = state_bytes_per_replica(state.opt_state)
+        # First call = compile + THE parity curve (same init, same
+        # batch, so the three curves are directly comparable).
+        state, losses = multi_fn(state, tokens)
+        variants[name] = {
+            "multi": multi_fn,
+            "state": state,
+            "losses": [float(x) for x in losses],
+            "state_bytes": state_bytes,
+        }
+        if name == "zero1":
+            raw_bytes = default_metrics().gauge("opt.grad_comm_bytes_raw")
+        if name == "int8":
+            quant_bytes = default_metrics().gauge(
+                "opt.grad_comm_bytes_quantized"
+            )
+
+    # Interleaved timing: each rep times every variant once, so no
+    # variant owns the quiet minutes (the PR 6 vs_baseline discipline).
+    # The host read-back of the last loss closes each timed window
+    # (async dispatch cannot fake it — the _run_train discipline).
+    for _ in range(reps):
+        for v in variants.values():
+            t0 = time.perf_counter()
+            v["state"], losses = v["multi"](v["state"], tokens)
+            float(losses[-1])
+            dt = (time.perf_counter() - t0) / steps
+            v["dt"] = min(v.get("dt", float("inf")), dt)
+
+    tps = {
+        name: batch * seq / v["dt"] for name, v in variants.items()
+    }
+    parity_fp32 = loss_parity(
+        variants["replicated"]["losses"], variants["zero1"]["losses"]
+    )
+    parity_int8 = loss_parity(
+        variants["replicated"]["losses"], variants["int8"]["losses"]
+    )
+    legs = zopt.measure_legs(variants["zero1"]["state"].params, metrics=m)
+    if not np.isfinite(variants["zero1"]["losses"][-1]):
+        raise RuntimeError(
+            f"non-finite zero1 loss {variants['zero1']['losses'][-1]}"
+        )
+    pair = {"zero1": tps["zero1"], "replicated": tps["replicated"]}
+    winner = max(pair, key=pair.get)
+    n_params = sum(
+        int(np.prod(np.shape(x))) for x in jax.tree.leaves(params)
+    )
+    return {
+        "n_devices": n_dev,
+        "dp": dp,
+        "fsdp": fsdp,
+        "steps": steps,
+        "params_millions": round(n_params / 1e6, 2),
+        # The zero1-vs-replicated competition: the block's headline is
+        # the WINNER's (never a config this run measured slower).
+        "tokens_per_sec": round(max(pair.values()), 1),
+        "winner": winner,
+        "zero1_tokens_per_sec": round(tps["zero1"], 1),
+        "replicated_tokens_per_sec": round(tps["replicated"], 1),
+        "int8_tokens_per_sec": round(tps["int8"], 1),
+        "vs_replicated": round(tps["zero1"] / tps["replicated"], 3),
+        # THE parity gate: fp32 zero1 must be BIT-EXACT vs replicated
+        # (elementwise update on shards — drift means a correctness
+        # bug); int8 must stay inside the gate's tolerance.
+        "loss_parity": parity_fp32["parity"],
+        "loss_drift": parity_fp32["max_rel_drift"],
+        "int8_parity": parity_int8["parity"],
+        "int8_loss_drift": round(parity_int8["max_rel_drift"], 5),
+        "parity_rel_tol": PARITY_REL_TOL,
+        "first_loss": round(variants["zero1"]["losses"][0], 4),
+        "final_loss": round(variants["zero1"]["losses"][-1], 4),
+        # Measured state HBM per dp replica (from the PLACED state's
+        # shardings — shrinks ~dp× under zero1) and the per-step grad
+        # communication payload raw vs quantized.
+        "state_bytes_replicated": variants["replicated"]["state_bytes"],
+        "state_bytes_per_replica": variants["zero1"]["state_bytes"],
+        "state_shrink": round(
+            variants["replicated"]["state_bytes"]
+            / max(variants["zero1"]["state_bytes"], 1),
+            2,
+        ),
+        "state_bytes_total": _tree_bytes(
+            variants["zero1"]["state"].opt_state
+        ),
+        "grad_comm_bytes_raw": int(raw_bytes),
+        "grad_comm_bytes_quantized": int(quant_bytes),
+        "gather_s": round(legs["gather_s"], 5),
+        "scatter_s": round(legs["scatter_s"], 5),
+    }
+
+
 # -- driver -------------------------------------------------------------------
 
 
@@ -1497,6 +1710,29 @@ def main() -> None:
             result["headline_config"] = result["ici"]["winner"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["ici"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "opt":
+        # `make opt-bench` / chip_checklist step: the distributed-
+        # optimizer A/B (zero1 vs replicated state, fp32 vs int8 grad
+        # comm) with loss parity asserted in the artifact and the
+        # winner as the headline — the same never-headline-slower
+        # invariant as the ingest/ici competitions (bench_smoke
+        # enforces).  Off-TPU it runs on the 8-device virtual mesh and
+        # the last_tpu_artifact trail (stamped above) marks a fallback.
+        result["metric"] = "opt_tokens_per_sec"
+        result["unit"] = "tokens/s"
+        try:
+            if platform != "tpu":
+                _ensure_virtual_mesh(8)
+            result["opt"] = _run_opt_ab(platform)
+            result["value"] = result["opt"]["tokens_per_sec"]
+            result["headline_config"] = result["opt"]["winner"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["opt"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

@@ -79,7 +79,11 @@ class TrainConfig:
     ``pp_chunks`` select the pipeline schedule
     (:func:`ddl_tpu.parallel.pipeline_apply`) and feed the models'
     ``*_pp`` entry points via :meth:`pipeline_kwargs`; ``accum_steps``
-    flows into the :class:`~ddl_tpu.trainer.Trainer` constructor.
+    flows into the :class:`~ddl_tpu.trainer.Trainer` constructor; the
+    distributed-optimizer knobs (``optimizer_sharding`` / ``grad_comm``
+    / ``grad_comm_block`` / ``stochastic_rounding``) flow into the step
+    factories via :meth:`optimizer_kwargs`
+    (``DDL_TPU_TRAIN_OPTIMIZER_SHARDING=zero1`` etc. from the env).
     """
 
     #: Remat policy for the backward pass (``ddl_tpu.models.remat``).
@@ -92,6 +96,21 @@ class TrainConfig:
     n_microbatches: int = 1
     #: Gradient-accumulation microbatches per optimizer update.
     accum_steps: int = 1
+    #: Distributed optimizer (``ddl_tpu.parallel.optimizer``): "none"
+    #: replicates the optimizer state across dp; "zero1" shards state +
+    #: weight update over the dp axis (ZeRO-1 — bit-exact at fp32,
+    #: ~dp× less optimizer HBM per replica).
+    optimizer_sharding: str = "none"
+    #: Gradient/update communication wire format: "fp32" (exact) or
+    #: "int8" (blockwise-scaled EQuARX format, licensed by the
+    #: loss-curve-parity gate — ``parallel.optimizer.loss_parity``).
+    grad_comm: str = "fp32"
+    #: int8 block size (values per fp32 scale); 0 = the collectives
+    #: default (``parallel.collectives.QUANT_BLOCK``).
+    grad_comm_block: int = 0
+    #: Stochastic rounding on the int8 wire format (unbiased in
+    #: expectation; deterministic given the step's gradient values).
+    stochastic_rounding: bool = False
 
     _ENV_PREFIX = "DDL_TPU_TRAIN_"
 
@@ -104,6 +123,15 @@ class TrainConfig:
         _remat.resolve(cfg.remat)  # fail on junk at load time
         if cfg.schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule {cfg.schedule!r}")
+        if cfg.optimizer_sharding not in ("none", "zero1"):
+            raise ValueError(
+                f"unknown optimizer_sharding {cfg.optimizer_sharding!r} "
+                "(valid: none, zero1)"
+            )
+        if cfg.grad_comm not in ("fp32", "int8"):
+            raise ValueError(
+                f"unknown grad_comm {cfg.grad_comm!r} (valid: fp32, int8)"
+            )
         return cfg
 
     def save(self, path: str) -> None:
@@ -119,6 +147,20 @@ class TrainConfig:
         return {
             "schedule": self.schedule,
             "n_chunks": self.pp_chunks or None,
+        }
+
+    def optimizer_kwargs(self) -> dict:
+        """kwargs for the step factories
+        (:func:`ddl_tpu.parallel.train.make_train_step` /
+        :func:`~ddl_tpu.parallel.train.make_multistep`): the
+        distributed-optimizer knobs, shaped for ``**`` splatting — the
+        single hand-off point, so the Trainer and the bench cannot
+        plumb a different subset."""
+        return {
+            "optimizer_sharding": self.optimizer_sharding,
+            "grad_comm": self.grad_comm,
+            "grad_comm_block": self.grad_comm_block,
+            "stochastic_rounding": self.stochastic_rounding,
         }
 
 

@@ -507,6 +507,28 @@ def north_star_report(
     report["ici_fanout_s"] = m.timer("ici.fanout").total_s
     report["ici_redistribute_s"] = m.timer("ici.redistribute").total_s
     report["ici_peak_bytes"] = m.gauge("ici.peak_bytes")
+    # Distributed optimizer (ddl_tpu/parallel/optimizer.py, ISSUE 8):
+    # optimizer-state bytes actually STORED per dp replica (shrinks ~dp×
+    # under zero1), the per-step gradient-communication payload raw vs
+    # quantized, and the measured collective-leg times.  The byte gauges
+    # are trace-time facts recorded on the default registry (the
+    # pp.bubble pattern — ShardedOptimizer.update cannot see a private
+    # registry from inside a trace); the leg timers come from
+    # ShardedOptimizer.measure_legs on whichever registry ran it.
+    report["opt_state_bytes_per_replica"] = default_metrics().gauge(
+        "opt.state_bytes_per_replica"
+    )
+    report["opt_state_bytes_total"] = default_metrics().gauge(
+        "opt.state_bytes_total"
+    )
+    report["opt_grad_comm_bytes_raw"] = default_metrics().gauge(
+        "opt.grad_comm_bytes_raw"
+    )
+    report["opt_grad_comm_bytes_quantized"] = default_metrics().gauge(
+        "opt.grad_comm_bytes_quantized"
+    )
+    report["opt_gather_s"] = m.timer("opt.gather").total_s
+    report["opt_scatter_s"] = m.timer("opt.scatter").total_s
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

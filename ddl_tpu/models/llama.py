@@ -80,6 +80,21 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def llama_4b() -> "LlamaConfig":
+        """The ≥4B fits-only-with-zero1 geometry (ISSUE 8): ~4.6B params
+        at the 8B config's layer shape, fp32 master weights.  On the
+        v5e-32 layout (dp=8 × fsdp=4, 16 GiB/chip) the persistent
+        residents (params + grads + adam moments) bust the per-chip HBM
+        with the optimizer state replicated over dp and fit with ~6 GiB
+        of activation headroom under ``optimizer_sharding="zero1"`` —
+        the accounting test (tests/test_optimizer.py) and
+        ``tools/probe_opt.py`` both price exactly this config."""
+        return LlamaConfig(
+            vocab=32768, d_model=4096, n_layers=20, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq=4096,
+        )
+
+    @staticmethod
     def tiny() -> "LlamaConfig":
         return LlamaConfig()
 
@@ -123,6 +138,15 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
         "final_norm": jnp.ones((d,), pdt),
         "lm_head": dense(next(keys), d, (d, cfg.vocab)),
     }
+
+
+def param_shapes(cfg: LlamaConfig) -> Params:
+    """Abstract (ShapeDtypeStruct) params pytree via ``eval_shape`` —
+    the zero-FLOP input for optimizer HBM accounting
+    (:func:`ddl_tpu.parallel.optimizer.hbm_accounting`, the
+    fits-only-with-zero1 test, ``tools/probe_opt.py``): a 4B-param
+    layout prices without materialising a single weight."""
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
 
 
 def param_specs(cfg: LlamaConfig) -> Params:

@@ -18,8 +18,13 @@ staged-ingest engine), ``watchdog.*`` / ``integrity.*`` / ``shuffle.*``
 (robustness events), ``ici.*`` (the device-side distribution tier —
 ``ici.bytes``/``ici.windows``/``ici.fallbacks`` counters, the
 ``ici.fanout``/``ici.redistribute`` dispatch timers, and the
-``ici.peak_bytes`` gauge asserted by the redistribution planner), and
-``cache.*`` (the shard cache —
+``ici.peak_bytes`` gauge asserted by the redistribution planner),
+``opt.*`` (the distributed optimizer —
+``opt.state_bytes_per_replica``/``opt.state_bytes_total`` gauges set at
+init from the placed state, ``opt.grad_comm_bytes_raw``/
+``opt.grad_comm_bytes_quantized`` per-step payload gauges set at trace
+time, and the ``opt.gather``/``opt.scatter`` collective-leg timers),
+and ``cache.*`` (the shard cache —
 ``cache.hits/misses/evictions/spills/spill_hits/spill_evictions/
 quarantined/warmed/backend_retries/backend_failures`` counters plus
 ``cache.resident_bytes`` / ``cache.spill_bytes`` gauges, whose ``.max``

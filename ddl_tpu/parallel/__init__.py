@@ -5,8 +5,12 @@ TPU-native re-design of the reference's MPI topology + exchange machinery
 mesh axes; collectives are XLA ops inserted by ``shard_map``/``pjit``.
 """
 
-from ddl_tpu.parallel.collectives import DeviceGlobalShuffler
+from ddl_tpu.parallel.collectives import (
+    DeviceGlobalShuffler,
+    quantized_all_reduce,
+)
 from ddl_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+from ddl_tpu.parallel.optimizer import ShardedOptimizer, hbm_accounting
 from ddl_tpu.parallel.pipeline import (
     bubble_fraction,
     pipeline_apply,
@@ -16,10 +20,13 @@ from ddl_tpu.parallel.pipeline import (
 
 __all__ = [
     "DeviceGlobalShuffler",
+    "ShardedOptimizer",
     "bubble_fraction",
     "data_parallel_mesh",
+    "hbm_accounting",
     "make_mesh",
     "pipeline_apply",
     "pipeline_spec",
+    "quantized_all_reduce",
     "stack_stage_params",
 ]

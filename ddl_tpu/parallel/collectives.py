@@ -1,4 +1,5 @@
-"""Device-side collectives: the TPU-native global shuffle.
+"""Device-side collectives: the TPU-native global shuffle + quantized
+gradient reduction.
 
 This is the re-imagining of reference ``ddl/shuffle.py``'s MPI exchange
 (``Sendrecv_replace`` between same-index producers across instances,
@@ -8,12 +9,22 @@ shared permutation with ``lax.ppermute`` — riding ICI/DCN, overlapping with
 compute, with zero host involvement.  The ``all_to_all`` strategy (the
 reference's never-finished second method, SURVEY Q8) redistributes the
 exchange block uniformly across *all* instances in one collective.
+
+The quantized-reduction half (:func:`quantize_blockwise` /
+:func:`quantized_all_reduce`) is the wire format of the distributed
+optimizer's gradient communication (EQuARX, arXiv:2506.17615): int8
+payloads with one fp32 scale per ``block`` values, an optional
+stochastic-rounding mode, and a two-phase all-reduce (int8
+reduce-scatter → local fp32 accumulation → re-quantized int8
+all-gather) for explicit-collective contexts
+(``ddl_tpu.parallel.optimizer`` consumes the same quantizer for the
+SPMD update gather).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -212,3 +223,166 @@ class DeviceGlobalShuffler:
         # lost round state across resume, replaying round-0 permutations).
         hook.owner = self
         return hook
+
+
+# -- quantized gradient communication (EQuARX wire format) -------------------
+#
+# Blockwise int8: one fp32 scale per ``block`` consecutive values along
+# the LAST axis (leading axes untouched, so an array's dp/fsdp sharding
+# survives quantization — with_sharding_constraint on the int8 payload
+# is what makes the optimizer's update all-gather move 1/4 the bytes).
+# ``q`` keeps the input's shape (int8), ``scales`` is
+# ``x.shape[:-1] + (ceil(last/block),)`` fp32.
+
+#: Default quantization granularity (values per fp32 scale).  256 keeps
+#: the scale overhead at ~1.6% of the int8 payload while bounding the
+#: per-block dynamic range loss (EQuARX uses the same order).
+QUANT_BLOCK = 256
+
+
+def block_scales(x: Any, block: int = QUANT_BLOCK) -> Any:
+    """Per-block fp32 scales: ``max(|x|)/127`` over each ``block``-wide
+    slice of the last axis (zero blocks get scale 1 so dequantize is
+    exact there)."""
+    import jax.numpy as jnp
+
+    lead, last = x.shape[:-1], x.shape[-1]
+    pad = (-last) % block
+    xf = jnp.abs(x.astype(jnp.float32))
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * len(lead) + [(0, pad)])
+    s = jnp.max(xf.reshape(*lead, -1, block), axis=-1) / 127.0
+    return jnp.where(s == 0.0, 1.0, s)
+
+
+def _expand_scales(s: Any, last: int, block: int) -> Any:
+    import jax.numpy as jnp
+
+    return jnp.repeat(s, block, axis=-1)[..., :last]
+
+
+def quantize_blockwise(
+    x: Any,
+    block: int = QUANT_BLOCK,
+    stochastic: bool = False,
+    key: Optional[Any] = None,
+) -> Tuple[Any, Any]:
+    """``x -> (q int8, scales fp32)`` with per-block scales.
+
+    ``stochastic=True`` rounds ``floor(v + u)`` with ``u ~ U[0, 1)``
+    drawn from ``key`` — unbiased in expectation (``E[q·s] = x``), the
+    rounding mode that keeps long accumulation chains drift-free where
+    round-to-nearest introduces a systematic bias.  Deterministic
+    round-to-nearest otherwise.  Rank-0 inputs are the caller's problem
+    (the optimizer tree walk passes scalars through unquantized).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if stochastic and key is None:
+        raise ValueError("stochastic rounding requires an explicit key")
+    s = block_scales(x, block)
+    v = x.astype(jnp.float32) / _expand_scales(s, x.shape[-1], block)
+    if stochastic:
+        v = jnp.floor(v + jax.random.uniform(key, x.shape))
+    else:
+        v = jnp.round(v)
+    q = jnp.clip(v, -127.0, 127.0).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_blockwise(
+    q: Any, scales: Any, dtype: Any, block: int = QUANT_BLOCK
+) -> Any:
+    """Inverse of :func:`quantize_blockwise` (up to rounding error)."""
+    import jax.numpy as jnp
+
+    out = q.astype(jnp.float32) * _expand_scales(
+        scales, q.shape[-1], block
+    )
+    return out.astype(dtype)
+
+
+def quantize_dequantize(
+    x: Any,
+    block: int = QUANT_BLOCK,
+    stochastic: bool = False,
+    key: Optional[Any] = None,
+) -> Any:
+    """Round-trip through the int8 wire format — the numerical effect a
+    quantized collective applies to the values it moves."""
+    q, s = quantize_blockwise(x, block, stochastic=stochastic, key=key)
+    return dequantize_blockwise(q, s, x.dtype, block)
+
+
+def quantized_bytes(shape: Any, block: int = QUANT_BLOCK) -> int:
+    """Wire bytes of one quantized array: int8 payload + fp32 scales."""
+    size = int(np.prod(shape)) if shape else 1
+    last = int(shape[-1]) if shape else 1
+    nblocks = -(-last // block)
+    lead = size // max(last, 1)
+    return size + 4 * lead * nblocks
+
+
+def quantized_all_reduce(
+    x: Any,
+    axis_name: str,
+    axis_size: int,
+    block: int = QUANT_BLOCK,
+    mean: bool = True,
+    stochastic: bool = False,
+    key: Optional[Any] = None,
+) -> Any:
+    """Two-phase quantized all-reduce for ``shard_map`` contexts.
+
+    Each device quantizes its contribution and the collective moves ONLY
+    int8 payloads + fp32 block scales: the flattened value splits into
+    ``axis_size`` chunks, an int8 ``all_to_all`` reduce-scatters them
+    (device *i* receives every peer's quantized chunk *i*), the chunk
+    accumulates locally in fp32, re-quantizes, and an int8 ``all_gather``
+    completes the reduction — the EQuARX two-phase structure, so the
+    error model (quantize → sum → re-quantize) matches the paper's.
+    Wire bytes per device ≈ ``2·(n-1)/n`` × the quantized payload vs the
+    same factor × fp32 for ``lax.psum``: a ~3.9× cut at block=256.
+
+    ``axis_size`` is explicit (static) because the chunk split must be
+    shape-static under trace; pass ``mesh.shape[axis]``.  ``mean=True``
+    divides by ``axis_size`` (the gradient-averaging convention).
+    ``stochastic=True`` + ``key``: stochastic rounding on BOTH quantize
+    phases (fold distinct data per phase yourself if you need
+    independent draws; the second phase folds in a constant).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if axis_size < 1:
+        raise ValueError(f"axis_size must be >= 1, got {axis_size}")
+    shape, dtype = x.shape, x.dtype
+    size = int(np.prod(shape)) if shape else 1
+    flat = x.reshape((size,))
+    pad = (-size) % (axis_size * block)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(axis_size, -1)  # (n, c): chunk i -> device i
+    k1 = k2 = None
+    if stochastic:
+        k1, k2 = jax.random.split(key)
+    q, s = quantize_blockwise(chunks, block, stochastic=stochastic, key=k1)
+    if axis_size > 1:
+        q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+        s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    red = jnp.sum(
+        q.astype(jnp.float32) * _expand_scales(s, q.shape[-1], block),
+        axis=0,
+    )
+    if mean:
+        red = red / axis_size
+    q2, s2 = quantize_blockwise(
+        red[None], block, stochastic=stochastic, key=k2
+    )
+    if axis_size > 1:
+        q2 = lax.all_gather(q2[0], axis_name)  # (n, c): full vector back
+        s2 = lax.all_gather(s2[0], axis_name)
+    out = q2.astype(jnp.float32) * _expand_scales(s2, q2.shape[-1], block)
+    return out.reshape((-1,))[:size].reshape(shape).astype(dtype)

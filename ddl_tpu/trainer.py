@@ -101,12 +101,16 @@ class Trainer:
         checkpoint exists in ``checkpoint_dir``).
 
         ``train_config`` (a :class:`ddl_tpu.config.TrainConfig`)
-        supplies the training hot-path defaults — today that is
-        ``accum_steps`` (an explicit argument wins; the default is the
-        ``None`` sentinel precisely so an explicit ``accum_steps=1``
-        can DISABLE accumulation against a config that asks for it);
-        its remat policy and pipeline schedule apply where the model is
-        BUILT (``train_config.model_config(cfg)`` /
+        supplies the training hot-path defaults — ``accum_steps`` (an
+        explicit argument wins; the default is the ``None`` sentinel
+        precisely so an explicit ``accum_steps=1`` can DISABLE
+        accumulation against a config that asks for it) and the
+        distributed-optimizer knobs (``optimizer_sharding="zero1"``
+        shards optimizer state + weight update over dp, ``grad_comm=
+        "int8"`` opts into the quantized wire format — both flow into
+        every step factory this Trainer builds); its remat policy and
+        pipeline schedule apply where the model is BUILT
+        (``train_config.model_config(cfg)`` /
         ``train_config.pipeline_kwargs()``), since the Trainer only
         ever sees the closed-over ``loss_fn``."""
         from ddl_tpu.parallel.train import make_train_step
@@ -116,6 +120,16 @@ class Trainer:
                 train_config.accum_steps if train_config is not None else 1
             )
         self.train_config = train_config
+        # Distributed-optimizer knobs (TrainConfig.optimizer_kwargs):
+        # zero1 state sharding / int8 grad comm flow into BOTH step
+        # factories (the per-batch step here and every window-stream
+        # multistep in _fit_windows) from the same dict, so the two
+        # paths cannot train under different optimizer semantics.
+        self._opt_kwargs = (
+            train_config.optimizer_kwargs()
+            if train_config is not None
+            else {}
+        )
 
         self.mesh = mesh
         self.checkpoint_dir = checkpoint_dir
@@ -132,7 +146,7 @@ class Trainer:
         self._accum_steps = accum_steps
         self._init_fn, self._step_fn = make_train_step(
             loss_fn, optimizer, mesh, param_specs, batch_spec=batch_spec,
-            accum_steps=accum_steps,
+            accum_steps=accum_steps, **self._opt_kwargs,
         )
         # window_stream multistep programs, keyed by steps-per-window, so
         # repeated fit() calls on one Trainer reuse the compiled scan.
@@ -323,6 +337,7 @@ class Trainer:
                     self._loss_fn, self._optimizer, self.mesh,
                     self._param_specs, batch_spec=self._batch_spec,
                     n_steps=n_steps, accum_steps=self._accum_steps,
+                    **self._opt_kwargs,
                 )
             # Re-insert at the MRU end (dict preserves insertion order);
             # trim the LRU end past the cap.

@@ -202,6 +202,11 @@ class TestNorthStarReport:
             # ICI ingest tier extras (ISSUE 7: ddl_tpu/parallel/ici)
             "ici_bytes", "ici_windows", "ici_fallbacks",
             "ici_fanout_s", "ici_redistribute_s", "ici_peak_bytes",
+            # distributed-optimizer extras (ISSUE 8:
+            # ddl_tpu/parallel/optimizer)
+            "opt_state_bytes_per_replica", "opt_state_bytes_total",
+            "opt_grad_comm_bytes_raw", "opt_grad_comm_bytes_quantized",
+            "opt_gather_s", "opt_scatter_s",
         }
         assert r["samples_per_sec"] > 0
 

@@ -173,6 +173,17 @@ VIOLATIONS = {
                 host = jax.device_get(block)   # D2H round-trip per window
                 return self._fan_out(host)
     """,
+    "DDL017": """
+        import jax
+
+        def make_train_step(loss_fn, optimizer):
+            def apply_step(params, opt_state, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                updates, opt_state = optimizer.update(grads, opt_state)
+                return params, opt_state, loss
+
+            return jax.jit(apply_step)   # params + opt state undonated
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -359,6 +370,34 @@ CLEAN = {
         def debug_dump(block):
             return np.asarray(block)   # not a distribution path
     """,
+    "DDL017": """
+        import functools
+
+        import jax
+
+        def make_train_step(loss_fn, optimizer, donate=True):
+            def apply_step(params, opt_state, batch):
+                return optimizer.update(params, opt_state, batch)
+
+            def init_fn(params):
+                # compiled-copy idiom: fresh donat-able buffers (exempt)
+                return jax.jit(lambda t: t, out_shardings=None)(params)
+
+            step = functools.partial(
+                jax.jit, donate_argnums=(0, 1) if donate else ()
+            )(apply_step)
+            return init_fn, step
+
+        def make_multistep(loss_fn, optimizer):
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def _run(params, opt_state, batch):
+                return optimizer.update(params, opt_state, batch)
+
+            return _run
+
+        def helper_outside_builders(fn):
+            return jax.jit(fn)   # not a configured train-step builder
+    """,
 }
 
 
@@ -516,6 +555,40 @@ class TestSelfTest:
         cfg = LintConfig(device_path_functions=["CustomTier.spread"])
         findings = lint_snippet(tmp_path, "DDL016", src, config=cfg)
         assert [f.code for f in findings] == ["DDL016"]
+
+    def test_ddl017_partial_and_decorator_forms(self, tmp_path):
+        """Both jit-construction spellings the builders use are checked:
+        a bare partial(jax.jit) missing donation fires, while donation
+        on the partial (the builders' real form) passes — and a
+        donation-less jit in a CONFIGURED method fires via the
+        Class.method qualification."""
+        src = """
+            import functools
+
+            import jax
+
+            class StepFactory:
+                def make_train_step(self, apply_step):
+                    return functools.partial(jax.jit)(apply_step)
+        """
+        cfg = LintConfig(train_step_functions=["StepFactory.make_train_step"])
+        findings = lint_snippet(tmp_path, "DDL017", src, config=cfg)
+        assert [f.code for f in findings] == ["DDL017"]
+        cfg = LintConfig(train_step_functions=["Other.make_train_step"])
+        findings = lint_snippet(tmp_path, "DDL017", src, config=cfg)
+        assert findings == [], findings
+
+    def test_ddl017_explicit_empty_donation_passes(self, tmp_path):
+        """donate_argnums=() is an explicit decision, not the hazard —
+        only the OMITTED kwarg fires."""
+        src = """
+            import jax
+
+            def make_train_step(apply_step):
+                return jax.jit(apply_step, donate_argnums=())
+        """
+        findings = lint_snippet(tmp_path, "DDL017", src)
+        assert findings == [], findings
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

@@ -4,7 +4,7 @@ JSON contract.
 CI-grade guard for the bench itself (`make bench-smoke` / `make check`):
 the full bench is too slow for per-PR runs, but its JSON line is an
 interface — round 2 shipped a bench whose output silently lost fields.
-Three passes:
+Four passes:
 
 1. `DDL_BENCH_MODE=ingest` with a small window/batch geometry — the
    last stdout line must parse as JSON and carry the staged-ingest
@@ -22,6 +22,13 @@ Three passes:
    byte-identical to the xla path, and the recorded winner must be the
    faster of the two paths the same run measured (the ici-vs-xla pair
    rides the ingest headline's never-slower invariant).
+2b. `DDL_BENCH_MODE=opt` — the distributed-optimizer A/B block must
+   carry its contract keys, fp32 zero1 must be loss-PARITY with the
+   replicated optimizer (bit-exact elementwise update), the int8 leg
+   must sit inside the parity gate, the per-replica state bytes must
+   shrink >= MIN_STATE_SHRINK, the quantized grad-comm payload must
+   undercut raw, and the recorded winner must be the faster of the
+   zero1/replicated pair the same run measured (never-slower).
 3. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
    schedule/bubble gauges) and its `pipeline_overhead` against the
@@ -109,6 +116,26 @@ REQUIRED_ICI = (
     "link_spec_bytes_per_s", "wire_bytes_per_s", "per_hop_bytes_per_s",
     "peak_factor", "fallbacks", "n_devices", "interpret",
 )
+#: The opt block's contract (ISSUE 8: DDL_BENCH_MODE=opt — the
+#: distributed-optimizer A/B).  ``tokens_per_sec`` must be the WINNER
+#: of the zero1-vs-replicated pair (never-headline-slower),
+#: ``loss_parity`` must hold (fp32 zero1 is BIT-EXACT vs replicated),
+#: the int8 leg must sit inside the parity gate's tolerance, the
+#: per-replica state bytes must actually shrink, and the quantized
+#: grad-comm payload must undercut the raw one.
+REQUIRED_OPT = (
+    "tokens_per_sec", "winner", "zero1_tokens_per_sec",
+    "replicated_tokens_per_sec", "int8_tokens_per_sec", "vs_replicated",
+    "loss_parity", "loss_drift", "int8_parity", "int8_loss_drift",
+    "parity_rel_tol", "state_bytes_replicated",
+    "state_bytes_per_replica", "state_shrink", "grad_comm_bytes_raw",
+    "grad_comm_bytes_quantized", "gather_s", "scatter_s", "n_devices",
+    "dp",
+)
+#: zero1 must cut per-replica optimizer-state bytes by at least this
+#: factor (the measured shrink is ~dp — 4.0 on the dp=4 smoke mesh —
+#: so 1.5 is noise-proof while still catching a sharding regression).
+MIN_STATE_SHRINK = 1.5
 
 
 def _run_bench(mode: str) -> "dict | None":
@@ -325,6 +352,83 @@ def main() -> int:
             f"({ici['fallbacks']} times) — the ici timings are not real"
         )
         return 1
+    # -- pass 2b: the distributed-optimizer A/B (ISSUE 8) --------------
+    opt_result = _run_bench("opt")
+    if opt_result is None:
+        return 1
+    opt = opt_result.get("opt")
+    if not isinstance(opt, dict):
+        print(json.dumps(opt_result, indent=1))
+        print(
+            "bench-smoke: no opt block "
+            f"(errors={opt_result.get('errors')})"
+        )
+        return 1
+    opt_missing = [k for k in REQUIRED_OPT if k not in opt]
+    if opt_missing:
+        print(json.dumps(opt, indent=1))
+        print(f"bench-smoke: opt block missing keys: {opt_missing}")
+        return 1
+    if opt["loss_parity"] is not True:
+        print(json.dumps(opt, indent=1))
+        print(
+            "bench-smoke: fp32 zero1 loss curve NOT parity with "
+            f"replicated (drift {opt['loss_drift']}) — the sharded "
+            "update changed the math"
+        )
+        return 1
+    if opt["int8_parity"] is not True:
+        print(json.dumps(opt, indent=1))
+        print(
+            "bench-smoke: int8 grad-comm loss drift "
+            f"{opt['int8_loss_drift']} outside the parity gate "
+            f"({opt['parity_rel_tol']})"
+        )
+        return 1
+    opt_pair = {
+        "zero1": opt["zero1_tokens_per_sec"],
+        "replicated": opt["replicated_tokens_per_sec"],
+    }
+    if opt["tokens_per_sec"] < max(opt_pair.values()):
+        print(json.dumps(opt, indent=1))
+        print(
+            f"bench-smoke: opt headline {opt['tokens_per_sec']} is "
+            f"slower than a config the same run measured ({opt_pair}) "
+            "— never-slower invariant violated"
+        )
+        return 1
+    # Tie-tolerant winner check: bench.py picks the winner on UNROUNDED
+    # rates while this block carries 0.1-rounded fields, so a near-tie
+    # may round equal — the label only fails when it names a config the
+    # rounded pair shows as strictly slower.
+    if (
+        opt["winner"] not in opt_pair
+        or opt_pair[opt["winner"]] < max(opt_pair.values())
+        or opt_result.get("headline_config") != opt["winner"]
+    ):
+        print(json.dumps(opt, indent=1))
+        print(
+            f"bench-smoke: opt winner label {opt['winner']!r} / "
+            f"headline_config {opt_result.get('headline_config')!r} do "
+            f"not name the measured winner ({opt_pair})"
+        )
+        return 1
+    if opt["state_shrink"] < MIN_STATE_SHRINK:
+        print(json.dumps(opt, indent=1))
+        print(
+            f"bench-smoke: zero1 state shrink {opt['state_shrink']}x "
+            f"< {MIN_STATE_SHRINK}x — the optimizer state is not "
+            "actually sharded"
+        )
+        return 1
+    if opt["grad_comm_bytes_quantized"] >= opt["grad_comm_bytes_raw"]:
+        print(json.dumps(opt, indent=1))
+        print(
+            "bench-smoke: quantized grad-comm payload "
+            f"{opt['grad_comm_bytes_quantized']} does not undercut raw "
+            f"{opt['grad_comm_bytes_raw']}"
+        )
+        return 1
     # -- pass 3: the training hot path (ISSUE 5) -----------------------
     overheads = []
     for attempt in range(1, FIT_ATTEMPTS + 1):
@@ -375,6 +479,9 @@ def main() -> int:
         f"{cache.get('warm_vs_cold') if isinstance(cache, dict) else '?'}x "
         "byte-identical; ici winner "
         f"{ici['winner']} vs_xla {ici['vs_xla']} byte-identical; "
+        f"opt winner {opt['winner']} vs_replicated "
+        f"{opt['vs_replicated']} parity (drift fp32 {opt['loss_drift']} "
+        f"int8 {opt['int8_loss_drift']}) state {opt['state_shrink']}x; "
         "fit_stream overhead "
         f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "
         f"(window_wait_s={fit['window_wait_s']})"

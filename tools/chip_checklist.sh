@@ -20,13 +20,18 @@
 #   6. ici     - fan-out kernel probe (real remote DMA) + the
 #                DDL_BENCH_MODE=ici distribution A/B (per-hop bytes/s,
 #                ICI link utilization, ici-vs-xla)
+#   7. opt     - distributed-optimizer probe + the DDL_BENCH_MODE=opt
+#                zero1-vs-replicated A/B (state bytes/replica, grad-comm
+#                bytes raw vs int8, loss parity) — ROADMAP item 2's
+#                pending chip half: train_big MFU with
+#                DDL_TPU_TRAIN_OPTIMIZER_SHARDING=zero1
 set -u
 cd "$(dirname "$0")/.."
 ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/6] probe =="
+echo "== [1/7] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -36,23 +41,23 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/6] on-chip test suite =="
+echo "== [2/7] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/6] full bench =="
+echo "== [3/7] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/6] big-model MFU bench =="
+echo "== [4/7] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
 
-echo "== [4b/6] serving decode bench (small + big, MBU-graded) =="
+echo "== [4b/7] serving decode bench (small + big, MBU-graded) =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
   2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
-echo "== [5/6] stream-bandwidth diagnosis + window-size sweep =="
+echo "== [5/7] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
 # fail loudly (step timeout), never silently record CPU numbers in a
 # TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
@@ -72,7 +77,7 @@ for MIB in 64 128; do
     | tee "$ART/bench-stream-$MIB-$STAMP.json"
 done
 
-echo "== [6/6] ICI fan-out probe + distribution A/B =="
+echo "== [6/7] ICI fan-out probe + distribution A/B =="
 # Real remote-DMA numbers for the device-side distribution tier
 # (ddl_tpu/parallel/ici.py): per-hop bytes/s from the kernel probe,
 # then the ici-vs-xla A/B with link utilization against the per-link
@@ -82,5 +87,21 @@ DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_ici.py \
   2>&1 | tee "$ART/ici-probe-$STAMP.txt" | tail -8
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici timeout 1200 python bench.py \
   2> "$ART/bench-ici-$STAMP.err" | tee "$ART/bench-ici-$STAMP.json"
+
+echo "== [7/7] distributed-optimizer probe + A/B =="
+# The zero1/int8 measurement the ISSUE-8 artifact needs on real HBM:
+# state bytes/replica from placed shardings, the int8 gather leg on
+# real ICI, loss parity re-asserted on-chip.  Then the train_big MFU
+# re-measure with the sharded optimizer engaged (ROADMAP item 2's
+# "MFU >= 0.60 at unchanged loss" — compare against the replicated
+# BENCH_TPU_r05 0.557 line).
+DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_opt.py \
+  2>&1 | tee "$ART/opt-probe-$STAMP.txt" | tail -8
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=opt timeout 1200 python bench.py \
+  2> "$ART/bench-opt-$STAMP.err" | tee "$ART/bench-opt-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big \
+  DDL_TPU_TRAIN_OPTIMIZER_SHARDING=zero1 timeout 3000 python bench.py \
+  2> "$ART/bench-big-zero1-$STAMP.err" \
+  | tee "$ART/bench-big-zero1-$STAMP.json"
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="

@@ -244,6 +244,94 @@ class CheckpointWithoutPolicy(Checker):
 
 
 @register
+class TrainStepWithoutDonation(Checker):
+    """DDL017: train-step ``jax.jit`` calls donate params + opt state.
+
+    Functions named in ``[tool.ddl_lint] train_step_functions`` (bare
+    names or ``Class.method``) build THE optimizer-step programs: a
+    ``jax.jit`` (or ``functools.partial(jax.jit, ...)``) inside them
+    that does not pass ``donate_argnums``/``donate_argnames`` keeps the
+    input params AND optimizer state alive across the step — with the
+    state replicated that silently doubles peak HBM at exactly the
+    geometries the distributed optimizer exists to fit (a ≥4B config's
+    extra copy is ~2× params in moments alone).  ``donate_argnums=()``
+    passes: stating "no donation" is an explicit decision; omitting the
+    kwarg is the hazard.
+
+    Exempt: jitting an INLINE LAMBDA (``jax.jit(lambda t: t,
+    out_shardings=...)``) — the compiled-copy/placement idiom, whose
+    whole point is producing fresh buffers the caller may later donate.
+    """
+
+    code = "DDL017"
+    summary = "train-step jax.jit without donate_argnums/donate_argnames"
+
+    _DONATE_KWS = {"donate_argnums", "donate_argnames"}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_step_builder(node):
+            self._check_body(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_step_builder(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "train_step_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_body(self, fn: ast.AST) -> None:
+        # Nested defs (and their decorator lists) stay in scope: the
+        # builders construct their jitted programs in closures.
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            jit_call = self._jit_construction(node)
+            if jit_call is None:
+                continue
+            if any(
+                kw.arg in self._DONATE_KWS for kw in jit_call.keywords
+            ):
+                continue
+            if self._is_inline_lambda_jit(jit_call):
+                continue
+            self.report(
+                node,
+                "jax.jit in a train-step builder without donate_argnums/"
+                "donate_argnames: undonated params + optimizer state "
+                "double peak HBM across the update; donate them (or "
+                "state donate_argnums=() explicitly)",
+            )
+
+    def _jit_construction(self, node: ast.Call) -> Optional[ast.Call]:
+        """The call whose keywords govern donation: the ``jax.jit(...)``
+        call itself, or the ``functools.partial(jax.jit, ...)`` wrapping
+        one (donation kwargs live on the partial)."""
+        dotted = dotted_name(node.func) or ""
+        seg = dotted.rsplit(".", 1)[-1]
+        if seg == "jit" and (dotted == "jit" or dotted.startswith("jax.")):
+            return node
+        if seg == "partial" and node.args:
+            inner = dotted_name(node.args[0]) or ""
+            iseg = inner.rsplit(".", 1)[-1]
+            if iseg == "jit" and (
+                inner == "jit" or inner.startswith("jax.")
+            ):
+                return node
+        return None
+
+    @staticmethod
+    def _is_inline_lambda_jit(jit_call: ast.Call) -> bool:
+        return bool(jit_call.args) and isinstance(
+            jit_call.args[0], ast.Lambda
+        )
+
+
+@register
 class JitInLoop(LoopDepthChecker):
     """DDL010: no ``jax.jit`` construction inside a loop body.
 

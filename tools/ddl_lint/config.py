@@ -41,6 +41,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL014",  # jax.checkpoint/remat without an explicit policy
     "DDL015",  # materialize-then-copy into the producer window view
     "DDL016",  # host round-trip in a device-distribution hot path
+    "DDL017",  # train-step jax.jit without donate_argnums/donate_argnames
 )
 
 
@@ -101,6 +102,16 @@ class LintConfig:
             "fanout_shard",
             "replicated_view",
             "_as_ring_input",
+        ]
+    )
+    #: Train-step builder functions (bare name or ``Class.method``): a
+    #: ``jax.jit``/``functools.partial(jax.jit, ...)`` inside them that
+    #: omits ``donate_argnums``/``donate_argnames`` is DDL017 (undonated
+    #: params + optimizer state double peak HBM across the update).
+    train_step_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "make_train_step",
+            "make_multistep",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -265,6 +276,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.device_path_functions = str_list(
         "device_path_functions", cfg.device_path_functions
+    )
+    cfg.train_step_functions = str_list(
+        "train_step_functions", cfg.train_step_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
