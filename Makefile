@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test check chaos
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench multihost cluster-test check chaos
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -54,6 +54,23 @@ opt-bench:
 opt-dryrun:
 	$(PY) tools/probe_opt.py
 
+# Topology-aware vs naive producer→consumer placement A/B over the
+# simulated fabric (ddl_tpu/cluster/placement.py; Cloud Collectives
+# rank reordering) + the membership chaos counters.
+placement-bench:
+	DDL_BENCH_MODE=placement JAX_PLATFORMS=cpu $(PY) bench.py
+
+# The full multi-process jax.distributed matrix: virtual-mesh legs
+# (dp, dp×sp, pp×dp, dp×ep), checkpoint resume, packed-stream fit, and
+# the cross-host elastic chaos leg (slow legs included).
+multihost:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_multihost.py -q
+
+# Cluster control-plane suite alone (membership/view-change/placement
+# units + the in-process host-loss recovery ladder).
+cluster-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_cluster.py -q
+
 # The one-shot local gate: static analysis + bench JSON contract (the
 # bench-smoke contract includes the cache block's byte-identity and
 # >=2x warm-vs-cold assertions).
@@ -64,7 +81,7 @@ check: lint bench-smoke
 # corruption/backend-failure ladder (tests/test_cache.py) + the ICI
 # DMA-failure → xla-fallback rung (tests/test_ici.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py -q
 
 # Distributed-optimizer suite alone (parity matrix, collective units,
 # the 4B fits-only-with-zero1 accounting test).

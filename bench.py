@@ -1329,6 +1329,90 @@ def _run_cache_ab() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _run_placement_ab() -> dict:
+    """Topology-aware vs naive producer→consumer placement (ISSUE 10,
+    Cloud Collectives arXiv:2105.14088 rank reordering).
+
+    Geometry: 8 mock hosts — 4 loader hosts and 4 trainer hosts — in 4
+    two-host islands deliberately PAIRED ACROSS ROLES (each island holds
+    one loader + one trainer host), so the naive rank-order round-robin
+    pairs every producer with a cross-island consumer while the planner
+    can ride 4 intra-island links.  Both assignments are MEASURED over
+    the simulated fabric (real memcpys, wire time priced by the declared
+    cost matrix — the cache bench's ThrottledBackend pattern): the ratio
+    is wall-clock, not model output.  The never-slower invariant holds
+    by construction (the naive order is always a candidate plan) and
+    bench_smoke gates the measured ratio.
+
+    The chaos half of the block: a seeded ``HOST_LOSS`` at
+    ``cluster.heartbeat`` drives one supervisor sweep through a real
+    epoch-fenced view change, so the ``view_changes``/``host_losses``
+    counters in the JSON chart the membership machinery itself.
+
+    Knobs: ``DDL_BENCH_PLACEMENT_PAYLOAD_MIB`` (default 4),
+    ``DDL_BENCH_PLACEMENT_REPS`` (default 3),
+    ``DDL_BENCH_PLACEMENT_INTRA_GBPS`` / ``_CROSS_GBPS`` (simulated
+    link speeds, default 8 / 1).
+    """
+    from ddl_tpu import faults
+    from ddl_tpu.cluster import (
+        ClusterSupervisor,
+        ClusterView,
+        HostInfo,
+        LinkCosts,
+        SimulatedFabric,
+        placement_report,
+    )
+    from ddl_tpu.faults import FaultKind, FaultPlan, FaultSpec
+    from ddl_tpu.observability import Metrics
+
+    payload = int(
+        float(os.environ.get("DDL_BENCH_PLACEMENT_PAYLOAD_MIB", "4"))
+        * (1 << 20)
+    )
+    reps = int(os.environ.get("DDL_BENCH_PLACEMENT_REPS", "3"))
+    intra = float(os.environ.get("DDL_BENCH_PLACEMENT_INTRA_GBPS", "8")) * 1e9
+    cross = float(os.environ.get("DDL_BENCH_PLACEMENT_CROSS_GBPS", "1")) * 1e9
+
+    loaders, trainers = (0, 1, 2, 3), (4, 5, 6, 7)
+    hosts = [
+        HostInfo(h, loader_ranks=(h + 1,)) for h in loaders
+    ] + [
+        HostInfo(h, trainer_ranks=(h - len(loaders),)) for h in trainers
+    ]
+    view = ClusterView.bootstrap(hosts, n_shards=32)
+    # Islands pair loader host h with trainer host 5-h style partners:
+    # (0,5) (1,4) (2,7) (3,6) — every naive round-robin pair (0→4, 1→5,
+    # 2→6, 3→7) crosses islands; the planner's pairs stay inside them.
+    costs = LinkCosts.islands(
+        [[0, 5], [1, 4], [2, 7], [3, 6]], intra, cross
+    )
+    block = placement_report(
+        view,
+        costs,
+        transfer=SimulatedFabric(costs),
+        payload_bytes=payload,
+        reps=reps,
+    )
+
+    # Membership chaos mini-run: one injected host loss through a REAL
+    # supervisor sweep — the counters prove the view-change machinery,
+    # not a hand-incremented dict.
+    m = Metrics()
+    sup = ClusterSupervisor(view, lease_s=60.0, metrics=m)
+    plan = FaultPlan(
+        [FaultSpec("cluster.heartbeat", FaultKind.HOST_LOSS,
+                   producer_idx=loaders[-1])]
+    )
+    with faults.armed(plan):
+        sup.sweep()
+    assert plan.fired, "HOST_LOSS spec never fired"
+    block["view_changes"] = m.counter("cluster.view_changes")
+    block["host_losses"] = m.counter("cluster.host_losses")
+    block["post_loss_epoch"] = sup.view.epoch
+    return block
+
+
 def _ensure_virtual_mesh(n: int) -> None:
     """Force an n-device CPU virtual mesh BEFORE the first backend touch
     (the ici A/B needs a ring to fan out over; a plain CPU attach exposes
@@ -1710,6 +1794,25 @@ def main() -> None:
             result["headline_config"] = result["ici"]["winner"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["ici"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "placement":
+        # `make placement-bench`: topology-aware vs naive producer→
+        # consumer placement over the simulated fabric (ISSUE 10), with
+        # the measured winner as the headline under the same never-
+        # headline-slower invariant as every other competition, plus
+        # the membership chaos counters (bench_smoke enforces).
+        result["metric"] = "placement_bytes_per_sec"
+        result["unit"] = "bytes/s"
+        try:
+            result["placement"] = _run_placement_ab()
+            result["value"] = result["placement"]["bytes_per_s"]
+            result["headline_config"] = result["placement"]["winner"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["placement"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

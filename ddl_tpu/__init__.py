@@ -29,6 +29,7 @@ __all__ = [
     "RunMode",
     "Topology",
     "Trainer",
+    "cluster",
     "distributed_dataloader",
 ]
 
@@ -47,4 +48,10 @@ def __getattr__(name: str):
         from ddl_tpu.trainer import Trainer
 
         return Trainer
+    if name == "cluster":
+        # The multi-host elastic control plane (membership, placement,
+        # loader-pool decoupling, recovery ladder).
+        import ddl_tpu.cluster as cluster
+
+        return cluster
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

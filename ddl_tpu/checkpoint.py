@@ -126,10 +126,16 @@ class LoaderCheckpoint:
     #: than a tier of guaranteed misses.
     cache_spill_dir: Optional[str] = None
     cache_key_schema: int = 0
+    #: Cluster membership fence (ddl_tpu.cluster): the view epoch at
+    #: capture time.  ``apply`` fast-forwards a resumed supervisor past
+    #: it so views minted after restore can never be mistaken for
+    #: pre-checkpoint ones (shard adoptions are epoch-fenced).
+    cluster_epoch: int = 0
 
     @staticmethod
     def capture(
-        loader: Any, shuffler: Any = None, cache: Any = None
+        loader: Any, shuffler: Any = None, cache: Any = None,
+        cluster: Any = None,
     ) -> "LoaderCheckpoint":
         round_ = 0
         if shuffler is not None:
@@ -145,7 +151,13 @@ class LoaderCheckpoint:
         # decide cache policy) as a side effect of checkpointing.
         store = cache if cache is not None else cache_mod.active_store()
         spill = getattr(store, "spill_dir", None) if store else None
+        # ``cluster`` is a ClusterSupervisor or a bare ClusterView.
+        cluster_epoch = 0
+        if cluster is not None:
+            view = getattr(cluster, "view", cluster)
+            cluster_epoch = int(getattr(view, "epoch", 0))
         return LoaderCheckpoint(
+            cluster_epoch=cluster_epoch,
             epoch=loader._epoch,
             target=loader._target,
             batches_in_window=loader._batches_in_window,
@@ -156,10 +168,16 @@ class LoaderCheckpoint:
             ),
         )
 
-    def apply(self, loader: Any, shuffler: Any = None) -> None:
+    def apply(
+        self, loader: Any, shuffler: Any = None, cluster: Any = None
+    ) -> None:
         loader._epoch = self.epoch
         loader._target = self.target
         loader._batches_in_window = self.batches_in_window
+        if cluster is not None and self.cluster_epoch:
+            restore = getattr(cluster, "restore_epoch", None)
+            if callable(restore):
+                restore(self.cluster_epoch)
         if self.cache_spill_dir:
             from ddl_tpu import cache as cache_mod
 

@@ -1,0 +1,65 @@
+"""ddl_tpu.cluster — multi-host elastic control plane.
+
+Four pieces (docs/ROBUSTNESS.md "Host loss and the view-change
+protocol", docs/DEPLOY.md "Multi-host bootstrap"):
+
+- **membership** — host leases + heartbeats layered over existing
+  liveness signals, and the deterministic epoch-fenced view-change
+  protocol (:class:`ClusterSupervisor`, :class:`ClusterView`).
+- **topology** — inter-host link costs, declared or probed
+  (:class:`LinkCosts`, :func:`probe_link_costs`).
+- **placement** — Cloud-Collectives-style producer→consumer rank
+  reordering over those costs, with the never-slower fallback
+  (:func:`plan_placement`, :func:`placement_report`).
+- **elastic** — the recovery ladder binding view changes to the live
+  pipeline: loader-pool shrink, shard adoption, cache warm start,
+  degraded shuffle until rejoin (:class:`ElasticCluster`).
+
+The loader-pool decoupling seam (:class:`LoaderPool`) is what makes
+loader ranks a resizable pool distinct from trainer ranks:
+``DistributedDataLoader`` consumes whatever pool the view publishes.
+"""
+
+from ddl_tpu.cluster.elastic import ElasticCluster, worker_alive_source
+from ddl_tpu.cluster.membership import (
+    ClusterSupervisor,
+    ClusterView,
+    HostInfo,
+    LeaseTable,
+    partition_shards,
+    view_change,
+    view_rejoin,
+)
+from ddl_tpu.cluster.placement import (
+    Placement,
+    SimulatedFabric,
+    measure_assignment,
+    modeled_bytes_per_s,
+    naive_placement,
+    placement_report,
+    plan_placement,
+)
+from ddl_tpu.cluster.pool import LoaderPool
+from ddl_tpu.cluster.topology import LinkCosts, probe_link_costs
+
+__all__ = [
+    "ClusterSupervisor",
+    "ClusterView",
+    "ElasticCluster",
+    "HostInfo",
+    "LeaseTable",
+    "LinkCosts",
+    "LoaderPool",
+    "Placement",
+    "SimulatedFabric",
+    "measure_assignment",
+    "modeled_bytes_per_s",
+    "naive_placement",
+    "partition_shards",
+    "placement_report",
+    "plan_placement",
+    "probe_link_costs",
+    "view_change",
+    "view_rejoin",
+    "worker_alive_source",
+]

@@ -25,6 +25,15 @@ class LoaderConfig:
     mode: str = RunMode.THREAD.value
     n_producers: int = 2
     nslots: int = 2
+    # host identity (ddl_tpu.cluster): with several consumer processes
+    # per physical host, jax.process_index() over-counts hosts — the
+    # membership view and placement engine need REAL host boundaries.
+    # -1/0 = auto-detect (DDL_TPU_HOST_ID/N_HOSTS env, then SLURM node
+    # vars, then procs_per_host arithmetic over the process grid —
+    # ddl_tpu.env.detect_host_identity).
+    host_id: int = -1
+    n_hosts: int = 0
+    procs_per_host: int = 0  # 0 = auto (SLURM_NTASKS_PER_NODE or 1)
     # batch geometry
     batch_size: int = 32
     n_epochs: int = 1

@@ -72,6 +72,13 @@ class _CorruptAhead(Exception):
     """
 
 
+class _TargetRevoked(Exception):
+    """Internal: the target being acquired left the loader pool (a
+    cluster view change dropped its host mid-acquire).  The acquire
+    paths re-normalise onto the published pool and retry; never escapes
+    the loader."""
+
+
 # Rank-tagged DEBUG call tracing on every method, as the reference wrapped
 # its three core classes (reference ``mpi_dataloader.py:106``); the hot
 # per-batch path (``__getitem__`` via dunder skip, ``_host_cols``
@@ -102,6 +109,7 @@ class DistributedDataLoader:
         timeout_s: float = 300.0,
         staged: Optional[bool] = None,
         distribute: Optional[str] = None,
+        cluster: Any = None,
     ):
         if output not in ("torch", "numpy", "jax"):
             raise ValueError(f"output must be torch|numpy|jax, got {output!r}")
@@ -135,6 +143,17 @@ class DistributedDataLoader:
         # with forced (blocking) flushes only where the ring actually
         # needs the slot back.
         self._release_backlog: "list" = []
+        # Loader-pool decoupling seam (ddl_tpu.cluster): the APPLIED
+        # LoaderPool this loader rotates over (members filtered to
+        # local ring targets).  None = every ring (the static topology
+        # the handshake reported).  Pool updates arrive asynchronously
+        # (cluster supervisor thread) as _pending_pool and are APPLIED
+        # on the consumer thread at window boundaries — rotation state
+        # is single-threaded by construction.
+        self._pool: Any = None
+        self._pool_generation = -1
+        self._pending_pool: Any = None
+        self._cluster = cluster
         if output == "jax":
             from ddl_tpu.ingest import DeviceIngestor
 
@@ -190,6 +209,20 @@ class DistributedDataLoader:
         self.shapes = [tuple(r.shape) for r in replies]
         self.dtypes = [np.dtype(r.dtype) for r in replies]
         connection.attach_rings()
+        # Cluster decoupling seam: consume from whatever loader pool the
+        # view publishes.  ``cluster`` may be the full recovery ladder
+        # (ElasticCluster — attach_loader wires pool-following + rung-2
+        # actions) or a bare ClusterSupervisor (pool-following only).
+        if cluster is not None:
+            if hasattr(cluster, "attach_loader"):
+                cluster.attach_loader(self)
+            else:
+                cluster.add_listener(
+                    lambda _old, new, _dead: self.apply_pool(
+                        new.loader_pool()
+                    )
+                )
+                self.apply_pool(cluster.view.loader_pool())
         # First window is acquired lazily on first __getitem__: acquiring
         # here (as the reference did, mpi_dataloader.py:172) would also make
         # the FINAL mark of a run block on a whole extra window that
@@ -426,14 +459,28 @@ class DistributedDataLoader:
             ring's drain-lookahead primitive acquires PAST the still-held
             slot (release order stays FIFO).  Acquisition is integrity-
             verified: a corrupt head window is quarantined and replayed
-            before anything is submitted downstream."""
+            before anything is submitted downstream.  A cluster view
+            change revoking the target mid-acquire rotates onto the
+            published pool and retries — the cross-host ladder's
+            consumer-side edge."""
             nonlocal cursor
+            self._apply_pending_pool()
+            cursor = self._next_target(cursor, include=True)
             target = cursor
-            ring = self.connection.rings[target]
             with annotate("ddl.window_acquire"), self.metrics.timed(
                 "consumer.wait"
             ):
-                slot = self._acquire_verified(target, held[target], timeout_s)
+                while True:
+                    try:
+                        slot = self._acquire_verified(
+                            target, held[target], timeout_s
+                        )
+                        break
+                    except _TargetRevoked:
+                        self._apply_pending_pool()
+                        cursor = self._next_target(cursor, include=True)
+                        target = cursor
+            ring = self.connection.rings[target]
             arr = self._slot_array(target, slot)
             # Ragged tail rows (nData not a batch multiple) are unserved,
             # exactly as in batch iteration.  bpw is per-TARGET: mixed
@@ -487,7 +534,7 @@ class DistributedDataLoader:
                     window, defer_metrics=True
                 )
             held[target] += 1
-            cursor = (cursor + 1) % self.n_producers
+            cursor = self._next_target(cursor)
             return [slot, target, payload, served, False]
 
         def release_early():
@@ -570,7 +617,7 @@ class DistributedDataLoader:
                 # Yielded after its early release: no longer an orphan.
                 self._staged_orphans.pop(0)
             # This window is now SERVED: commit the rotation.
-            self._target = (target + 1) % self.n_producers
+            self._target = self._next_target(target)
             return dev
 
         # Inherit a superseded/abandoned stream's early-released windows:
@@ -581,7 +628,7 @@ class DistributedDataLoader:
         for entry in self._staged_orphans:
             pending.append(entry)
         if pending:
-            cursor = (pending[-1][1] + 1) % self.n_producers
+            cursor = self._next_target(pending[-1][1])
 
         # Yield-bounded up front: the generator serves exactly the
         # epochs left, so exhausting it eagerly (e.g. list()) before
@@ -656,6 +703,83 @@ class DistributedDataLoader:
                     break
             yield finish(pending.popleft())
 
+    # -- loader-pool decoupling seam (ddl_tpu.cluster) ---------------------
+
+    def apply_pool(self, pool: Any) -> None:
+        """Adopt a published :class:`~ddl_tpu.cluster.pool.LoaderPool`.
+
+        Thread-safe entry point (called from the cluster supervisor's
+        sweep thread): the pool is only RECORDED here; rotation state
+        changes on the consumer thread at the next window boundary
+        (``_apply_pending_pool``), and a consumer blocked on a ring the
+        new pool drops is unblocked by target revocation inside the
+        sliced acquire.  Stale generations (<= the applied one) are
+        ignored — the epoch fence.
+        """
+        cur = self._pending_pool
+        if cur is not None and cur.generation >= pool.generation:
+            return  # a newer pool is already pending; keep the fence
+        if pool.generation <= self._pool_generation:
+            return  # stale relative to what was already applied
+        self._pending_pool = pool
+
+    def _apply_pending_pool(self) -> None:
+        """Consumer-thread half of :meth:`apply_pool`."""
+        pool = self._pending_pool
+        if pool is None:
+            return
+        self._pending_pool = None
+        if pool.generation <= self._pool_generation:
+            return  # stale fence: view N must never undo view N+1
+        from ddl_tpu.cluster.pool import LoaderPool
+
+        members = tuple(
+            m for m in pool.members if 0 <= m < self.n_producers
+        )
+        if not members:
+            raise LoaderStateError(
+                "loader pool update left no local ring targets "
+                f"(pool={pool.members}, rings={self.n_producers})"
+            )
+        self._pool = LoaderPool(members=members, generation=pool.generation)
+        self._pool_generation = pool.generation
+        self.metrics.incr("consumer.pool_updates")
+        self.metrics.set_gauge("consumer.pool_size", len(members))
+        if self._target not in self._pool:
+            # The current target's host left: drop any partially-served
+            # window (its remaining batches are re-partitioned to the
+            # survivors by shard adoption) and rotate onto the pool.
+            self._batches_in_window = 0
+            self._release_current()
+            self._target = self._next_target(self._target)
+
+    def _next_target(self, t: int, include: bool = False) -> int:
+        """The next ACTIVE ring target cyclically after ``t`` (or ``t``
+        itself when ``include`` and it is active) — all rotation goes
+        through here, delegating to the applied pool's
+        :meth:`~ddl_tpu.cluster.pool.LoaderPool.next_member` (ONE
+        implementation of the rotation rule), so the pool seam has one
+        bypass-proof gate."""
+        if self._pool is None:
+            return t % self.n_producers if include else (
+                (t + 1) % self.n_producers
+            )
+        return self._pool.next_member(t, include=include)
+
+    def _target_revoked(self, target: int) -> bool:
+        """True when ``target`` is outside the active pool or about to
+        be dropped by a pending one — the sliced acquire polls this so
+        a consumer blocked on a dead host's ring unblocks at the view
+        change instead of its full timeout."""
+        if self._pool is not None and target not in self._pool:
+            return True
+        pool = self._pending_pool
+        return (
+            pool is not None
+            and pool.generation > self._pool_generation
+            and target not in pool
+        )
+
     # -- progress marks ------------------------------------------------------
 
     def mark(self, marker: Marker) -> None:
@@ -694,7 +818,8 @@ class DistributedDataLoader:
         return self.connection.rings[self._target]
 
     def _advance_to_next_producer(self) -> None:
-        self._target = (self._target + 1) % self.n_producers
+        self._apply_pending_pool()
+        self._target = self._next_target(self._target)
 
     def _slot_array(self, target: int, slot: int) -> np.ndarray:
         """Zero-copy window view of an acquired slot, shaped for ``target``."""
@@ -782,11 +907,45 @@ class DistributedDataLoader:
         stops deepening and the window re-verifies when it reaches the
         head."""
         ring = self.connection.rings[target]
-        slot = (
-            ring.acquire_drain_ahead(ahead, timeout_s)
-            if ahead
-            else ring.acquire_drain(timeout_s)
+        pool_managed = (
+            self._cluster is not None
+            or self._pool is not None
+            or self._pending_pool is not None
         )
+        if not pool_managed:
+            slot = (
+                ring.acquire_drain_ahead(ahead, timeout_s)
+                if ahead
+                else ring.acquire_drain(timeout_s)
+            )
+        else:
+            # Cluster-attached acquire (head AND lookahead): sliced so
+            # a view change that drops THIS target mid-wait revokes the
+            # acquire promptly (the dead host's producer will never
+            # commit again; waiting out the full timeout would stall
+            # recovery by minutes).  A shut-down ring below a pending
+            # view change is the same revocation, not run teardown.
+            deadline = time.monotonic() + timeout_s
+            while True:
+                if self._target_revoked(target):
+                    raise _TargetRevoked(target)
+                try:
+                    remaining = min(
+                        0.25, max(0.0, deadline - time.monotonic())
+                    )
+                    slot = (
+                        ring.acquire_drain_ahead(ahead, remaining)
+                        if ahead
+                        else ring.acquire_drain(remaining)
+                    )
+                    break
+                except StallTimeoutError:
+                    if time.monotonic() >= deadline:
+                        raise
+                except ShutdownRequested:
+                    if self._target_revoked(target):
+                        raise _TargetRevoked(target)
+                    raise
         if not self._integrity:
             return slot
         expect = self._expected_seq(target, ahead)
@@ -914,10 +1073,23 @@ class DistributedDataLoader:
             )
         # The annotation makes window-wait stalls visible on the profiler
         # timeline next to the XLA ops (SURVEY §5.1 TPU-native tracing).
+        self._apply_pending_pool()
         with annotate("ddl.window_acquire"), self.metrics.timed(
             "consumer.wait"
         ):
-            slot = self._acquire_verified(self._target, 0, self.timeout_s)
+            while True:
+                try:
+                    slot = self._acquire_verified(
+                        self._target, 0, self.timeout_s
+                    )
+                    break
+                except _TargetRevoked:
+                    # The target's host left the view mid-acquire:
+                    # adopt the published pool and retry on a survivor.
+                    self._apply_pending_pool()
+                    self._target = self._next_target(
+                        self._target, include=True
+                    )
         self._cur_slot = slot
         self._cur_array = self._slot_array(self._target, slot)
         self.metrics.incr("consumer.windows")

@@ -43,6 +43,7 @@ from ddl_tpu.types import (
     MetaData_Producer_To_Consumer,
     ReplayRequest,
     RunMode,
+    ShardAdoption,
     Topology,
     normalize_splits,
 )
@@ -109,6 +110,8 @@ class DataPusher:
         self.nslots = nslots
         self.metrics = metrics or default_metrics()
         self._iteration = 0
+        # Last applied cluster view epoch (ShardAdoption fence).
+        self._view_epoch = -1
 
         # End-to-end window integrity (ddl_tpu.integrity): slots carry a
         # checksummed trailer header past the payload; the flag rides the
@@ -454,6 +457,8 @@ class DataPusher:
                 return
             if isinstance(msg, ReplayRequest):
                 self._handle_replay(msg.seq)
+            elif isinstance(msg, ShardAdoption):
+                self._handle_adoption(msg)
             elif isinstance(msg, str) and msg == _abort_sentinel():
                 raise ShutdownRequested("consumer abort broadcast")
             else:
@@ -461,6 +466,51 @@ class DataPusher:
                     "producer %d: ignoring unexpected control message %r",
                     self.producer_idx, type(msg).__name__,
                 )
+
+    def _handle_adoption(self, msg: ShardAdoption) -> None:
+        """Apply a cluster view change (``ddl_tpu.cluster``): adopt the
+        re-partitioned shard ranges and suspend/resume the exchange.
+
+        Epoch-fenced: a message at or below the last applied view epoch
+        is DROPPED — view changes are ordered by construction and a
+        slow/duplicated view-N message must never undo view N+1.
+        """
+        applied = self._view_epoch
+        if msg.view_epoch <= applied:
+            logger.debug(
+                "producer %d: dropping stale adoption (epoch %d <= %d)",
+                self.producer_idx, msg.view_epoch, applied,
+            )
+            return
+        self._view_epoch = msg.view_epoch
+        logger.warning(
+            "producer %d: adopting shard ranges %s at view epoch %d "
+            "(peer %d/%d)",
+            self.producer_idx, msg.ranges, msg.view_epoch,
+            msg.peer_idx, msg.n_peers,
+        )
+        self.metrics.incr("producer.shard_adoptions")
+        if msg.suspend_exchange is not None and self.shuffler is not None:
+            # The ladder's shuffle rung: degrade to node-local while the
+            # exchange permutation still names a dead host; resume at
+            # the rejoin fence.
+            if msg.suspend_exchange:
+                suspend = getattr(self.shuffler, "suspend_exchange", None)
+                if callable(suspend):
+                    suspend()
+            else:
+                resume = getattr(self.shuffler, "resume_exchange", None)
+                if callable(resume):
+                    resume()
+        execute_callbacks(
+            self.callbacks,
+            "adopt_shards",
+            ranges=msg.ranges,
+            view_epoch=msg.view_epoch,
+            peer_idx=msg.peer_idx,
+            n_peers=msg.n_peers,
+            my_ary=self.my_ary,
+        )
 
     def _handle_replay(self, seq: int) -> None:
         """Rewind the producer function to logical window ``seq`` and

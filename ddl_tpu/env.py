@@ -49,14 +49,76 @@ logger = logging.getLogger("ddl_tpu")
 ABORT = "__ddl_tpu_abort__"
 
 
+def detect_host_identity(
+    n_instances: int = 1,
+    instance_idx: int = 0,
+    host_id: Optional[int] = None,
+    n_hosts: Optional[int] = None,
+) -> tuple[int, int]:
+    """``(host_id, n_hosts)`` for this consumer process.
+
+    Fixes the latent one-consumer-per-host skew: the original SLURM
+    recipe (docs/DEPLOY.md) equated ``jax.process_index()`` with the
+    host, which is wrong the moment a host runs more than one consumer
+    process (one per chip is the common TPU layout) — the cluster
+    membership view and the placement engine would then see 4x the real
+    host count and "place" transport onto links that do not exist.
+    Resolution order, later layers only filling gaps:
+
+    1. explicit arguments (``LoaderConfig.host_id``/``n_hosts`` threaded
+       through :func:`distributed_dataloader`),
+    2. ``DDL_TPU_HOST_ID`` / ``DDL_TPU_N_HOSTS`` env,
+    3. SLURM node identity (``SLURM_NODEID`` / ``SLURM_NNODES`` — per
+       NODE, not per task, so co-located tasks agree),
+    4. processes-per-host arithmetic over the process grid
+       (``DDL_TPU_PROCS_PER_HOST``, else ``SLURM_NTASKS_PER_NODE``,
+       else 1 — the historical host==instance reading).
+    """
+    def _env_int(name: str) -> Optional[int]:
+        raw = os.environ.get(name)
+        return int(raw) if raw not in (None, "") else None
+
+    if host_id is None:
+        host_id = _env_int("DDL_TPU_HOST_ID")
+    if n_hosts is None:
+        n_hosts = _env_int("DDL_TPU_N_HOSTS")
+    if host_id is None and n_hosts is None:
+        slurm_node = _env_int("SLURM_NODEID")
+        slurm_nodes = _env_int("SLURM_NNODES")
+        if slurm_node is not None and slurm_nodes is not None:
+            host_id, n_hosts = slurm_node, slurm_nodes
+    if host_id is None or n_hosts is None:
+        pph = (
+            _env_int("DDL_TPU_PROCS_PER_HOST")
+            or _env_int("SLURM_NTASKS_PER_NODE")
+            or 1
+        )
+        pph = max(1, pph)
+        if n_hosts is None:
+            n_hosts = max(1, (n_instances + pph - 1) // pph)
+        if host_id is None:
+            host_id = min(instance_idx // pph, n_hosts - 1)
+    # Layers may have resolved independently (an explicit host_id with
+    # an arithmetic n_hosts): widen n_hosts to cover the id instead of
+    # crashing Topology validation on a half-set environment.
+    if host_id >= n_hosts:
+        n_hosts = host_id + 1
+    return int(host_id), int(n_hosts)
+
+
 def detect_topology(
-    n_producers: Optional[int] = None, mode: Optional[RunMode | str] = None
+    n_producers: Optional[int] = None,
+    mode: Optional[RunMode | str] = None,
+    host_id: Optional[int] = None,
+    n_hosts: Optional[int] = None,
 ) -> Topology:
     """Build the topology from args + environment.
 
     The reference derived ``n_instances`` from SLURM env vars
     (``ddl_env.py:103-107``); here MULTIHOST mode derives it from the JAX
-    process grid, and single-host modes use one instance.
+    process grid, and single-host modes use one instance.  Host identity
+    (distinct from the process grid — several consumer processes may
+    share a host) comes from :func:`detect_host_identity`.
     """
     if mode is None:
         mode = os.environ.get("DDL_TPU_MODE", RunMode.THREAD.value)
@@ -70,11 +132,16 @@ def detect_topology(
         instance_idx = jax.process_index()
     else:
         n_instances, instance_idx = 1, 0
+    host_id, n_hosts = detect_host_identity(
+        n_instances, instance_idx, host_id=host_id, n_hosts=n_hosts
+    )
     return Topology(
         n_instances=n_instances,
         instance_idx=instance_idx,
         n_producers=n_producers,
         mode=mode,
+        host_id=host_id,
+        n_hosts=n_hosts,
     )
 
 
@@ -207,6 +274,43 @@ def _export_cache_knobs(config: Any) -> None:
         os.environ["DDL_TPU_CACHE_SPILL_DIR"] = config.cache_spill_dir
     else:
         os.environ.pop("DDL_TPU_CACHE_SPILL_DIR", None)
+
+
+#: Cluster env vars THIS process exported from a config (never user-set
+#: ones): a later run whose config states no opinion clears exactly
+#: these, so one run's explicit identity cannot leak into the next —
+#: the documented _export_cache_knobs precedent, made precise.
+_exported_cluster_vars: set = set()
+
+
+def _export_cluster_knobs(config: Any) -> None:
+    """Mirror a LoaderConfig's host-identity fields into the
+    ``DDL_TPU_HOST_ID``/``DDL_TPU_N_HOSTS``/``DDL_TPU_PROCS_PER_HOST``
+    environment BEFORE producers spawn (the ``_export_cache_knobs``
+    pattern): PROCESS/MULTIHOST workers re-derive host identity from
+    the environment they inherit, and the cluster view each side builds
+    must agree on host boundaries.  Sentinel values (-1/0 = auto) state
+    no opinion: they leave USER-set environment untouched, but clear
+    any export a previous config-driven run in this process made —
+    otherwise the second run would silently inherit the first run's
+    explicit identity as its "auto-detected" one.
+    """
+    if config is None:
+        return
+    for var, value, has_opinion in (
+        ("DDL_TPU_HOST_ID", getattr(config, "host_id", -1),
+         getattr(config, "host_id", -1) >= 0),
+        ("DDL_TPU_N_HOSTS", getattr(config, "n_hosts", 0),
+         getattr(config, "n_hosts", 0) > 0),
+        ("DDL_TPU_PROCS_PER_HOST", getattr(config, "procs_per_host", 0),
+         getattr(config, "procs_per_host", 0) > 0),
+    ):
+        if has_opinion:
+            os.environ[var] = str(value)
+            _exported_cluster_vars.add(var)
+        elif var in _exported_cluster_vars:
+            os.environ.pop(var, None)
+            _exported_cluster_vars.discard(var)
 
 
 class WorkerSet:
@@ -369,17 +473,23 @@ def distributed_dataloader(
     decorated main under ``if __name__ == "__main__":`` (standard spawn
     requirement), or the re-imported script will recursively spawn.
     """
+    host_id = n_hosts = None
     if config is not None:
         n_producers = (
             config.n_producers if n_producers is None else n_producers
         )
         mode = config.mode if mode is None else mode
         nslots = config.nslots if nslots is None else nslots
+        # Host identity (ddl_tpu.cluster): config sentinels (-1/0) mean
+        # auto-detect inside detect_topology; explicit values win.
+        host_id = config.host_id if getattr(config, "host_id", -1) >= 0 else None
+        n_hosts = config.n_hosts if getattr(config, "n_hosts", 0) > 0 else None
 
     def deco(f: Callable[..., Any]) -> Callable[..., Any]:
         @functools.wraps(f)
         def wrapper(*args: Any, **kwargs: Any) -> Any:
-            topology = detect_topology(n_producers, mode)
+            _export_cluster_knobs(config)
+            topology = detect_topology(n_producers, mode, host_id, n_hosts)
             depth = nslots or int(os.environ.get("DDL_TPU_NSLOTS", "2"))
             _export_cache_knobs(config)
             workers = WorkerSet(topology, depth, shuffler_factory)

@@ -86,6 +86,29 @@ class BackendFetchError(TransportError):
     """
 
 
+class HostLostError(DDLError):
+    """A whole host left the cluster view (lease expiry, declared loss,
+    or the ``HOST_LOSS`` fault kind at ``cluster.heartbeat``).
+
+    Carries the host id in ``args`` where the raiser knows it.  The
+    membership control plane (:mod:`ddl_tpu.cluster`) catches it during
+    a sweep and runs the epoch-fenced view change; it never escapes a
+    healthy supervisor loop.
+    """
+
+
+class HeartbeatDropped(DDLError):
+    """One heartbeat was lost in flight (the ``HEARTBEAT_DROP`` fault
+    kind at ``cluster.heartbeat``, or a real transport hiccup an adapter
+    chooses to report this way).
+
+    The lease table treats a dropped beat as silence: the lease keeps
+    aging and only EXPIRY — never a single drop — triggers a view
+    change, so transient heartbeat loss under the lease budget is
+    absorbed without membership churn.
+    """
+
+
 class InjectedFault(DDLError):
     """A deliberate failure raised by the fault-injection engine.
 

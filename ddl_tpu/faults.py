@@ -56,6 +56,17 @@ Injection points shipped today (site — fault kinds that act there):
                           fan-out kernel dispatch) — the distributor
                           latches a fallback to the ``xla`` scatter path
                           and counts ``ici.fallbacks``
+``cluster.heartbeat``     membership control plane, once per host per
+                          sweep (``producer_idx`` carries the HOST id):
+                          ``HEARTBEAT_DROP`` loses that beat (the lease
+                          keeps aging — only expiry changes the view);
+                          ``HOST_LOSS`` declares the host dead NOW (the
+                          injected analog of a rack losing power)
+``cluster.view_change``   inside ``ClusterSupervisor`` just before the
+                          epoch-fenced successor view is computed — a
+                          crash/spurious-shutdown here exercises the
+                          supervisor's own sweep-crash discrimination
+                          (the watchdog.sweep contract, host-level)
 ========================  ====================================================
 """
 
@@ -72,6 +83,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ddl_tpu.exceptions import (
     BackendFetchError,
     DDLError,
+    HeartbeatDropped,
+    HostLostError,
     InjectedFault,
     ShutdownRequested,
 )
@@ -92,6 +105,8 @@ class FaultKind(enum.Enum):
     CACHE_CORRUPTION = "cache_corruption"
     BACKEND_FETCH_FAIL = "backend_fetch_fail"
     ICI_DMA_FAIL = "ici_dma_fail"
+    HOST_LOSS = "host_loss"
+    HEARTBEAT_DROP = "heartbeat_drop"
 
 
 @dataclasses.dataclass
@@ -251,6 +266,16 @@ class FaultPlan:
             # must handle it exactly as it would a live remote-store
             # hiccup (that ladder is what the injection tests).
             raise BackendFetchError(f"backend fetch failure {where}")
+        elif kind is FaultKind.HOST_LOSS:
+            # Raised as the REAL membership type (the BACKEND_FETCH_FAIL
+            # pattern): the supervisor's sweep must handle it exactly as
+            # it would a declared host death — immediate epoch-fenced
+            # view change, not lease aging.
+            raise HostLostError(f"host loss {where}")
+        elif kind is FaultKind.HEARTBEAT_DROP:
+            # Also the real type: the sweep counts the drop and lets the
+            # lease age — a single lost beat must NEVER change the view.
+            raise HeartbeatDropped(f"heartbeat dropped {where}")
         elif kind is FaultKind.SHUFFLE_PEER_LOSS:
             raise DDLError(f"shuffle peer loss {where}")
         else:  # pragma: no cover - FaultKind is closed above

@@ -529,6 +529,19 @@ def north_star_report(
     )
     report["opt_gather_s"] = m.timer("opt.gather").total_s
     report["opt_scatter_s"] = m.timer("opt.scatter").total_s
+    # Multi-host control plane (ddl_tpu.cluster, ISSUE 10): membership
+    # churn (view changes / host losses / rejoins) and the recovery
+    # ladder's cross-host actions (shard adoptions, cache warm-start
+    # adoptions, consumer pool updates).  A "passing" run that silently
+    # lost a host and re-partitioned mid-stream must be visible in the
+    # BENCH_* trajectories, exactly like respawns and replays.
+    report["view_changes"] = m.counter("cluster.view_changes")
+    report["host_losses"] = m.counter("cluster.host_losses")
+    report["host_rejoins"] = m.counter("cluster.rejoins")
+    report["heartbeats_dropped"] = m.counter("cluster.heartbeats_dropped")
+    report["shard_adoptions"] = m.counter("producer.shard_adoptions")
+    report["cluster_cache_adoptions"] = m.counter("cluster.cache_adoptions")
+    report["pool_updates"] = m.counter("consumer.pool_updates")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

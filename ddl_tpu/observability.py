@@ -24,11 +24,18 @@ staged-ingest engine), ``watchdog.*`` / ``integrity.*`` / ``shuffle.*``
 init from the placed state, ``opt.grad_comm_bytes_raw``/
 ``opt.grad_comm_bytes_quantized`` per-step payload gauges set at trace
 time, and the ``opt.gather``/``opt.scatter`` collective-leg timers),
-and ``cache.*`` (the shard cache —
+``cache.*`` (the shard cache —
 ``cache.hits/misses/evictions/spills/spill_hits/spill_evictions/
 quarantined/warmed/backend_retries/backend_failures`` counters plus
 ``cache.resident_bytes`` / ``cache.spill_bytes`` gauges, whose ``.max``
-high-water marks ride along automatically).
+high-water marks ride along automatically), and ``cluster.*`` (the
+multi-host control plane, ``ddl_tpu.cluster`` —
+``cluster.view_changes/host_losses/rejoins/heartbeats/
+heartbeats_dropped/shard_adoptions/cache_adoptions`` counters, the
+``cluster.epoch``/``cluster.hosts`` gauges, plus the consumer-side
+pool seam's ``consumer.pool_updates`` counter / ``consumer.pool_size``
+gauge and the producer-side ``producer.shard_adoptions`` /
+``shuffle.suspensions/resumes/suspended_rounds`` ladder counters).
 """
 
 from __future__ import annotations

@@ -34,11 +34,15 @@ class CallbackProtocol(Protocol):
 
 
 #: Hook names considered valid dispatch positions.  ``fast_forward`` is
-#: dispatched once on elastic rejoin, before the hot loop resumes.
+#: dispatched once on elastic rejoin, before the hot loop resumes;
+#: ``adopt_shards`` is dispatched when a cluster view change
+#: re-partitions a dead host's shard range onto this producer
+#: (``ddl_tpu.cluster``, ShardAdoption control message).
 CALLBACK_POSITIONS: tuple[str, ...] = (
     "on_init",
     "post_init",
     "fast_forward",
+    "adopt_shards",
     "on_push_begin",
     "global_shuffle",
     "execute_function",

@@ -422,6 +422,12 @@ class ThreadExchangeShuffler:
         self.metrics = default_metrics()
         self._peer_losses = 0  # consecutive; reset by a healthy round
         self._degraded = False  # terminal: exchange disabled for the run
+        # Reversible degrade (cross-host elastic ladder): while True,
+        # every round shuffles node-locally — the exchange permutation
+        # still names a departed host and would stall each round until
+        # timeout.  Unlike _degraded this rung EXITS: resume_exchange()
+        # at the rejoin fence (ddl_tpu.cluster.elastic).
+        self._suspended = False
         self._rdv = rendezvous or _default_rendezvous
         self._round = 0
         # Outgoing keys of the last two rounds: swept when their replay
@@ -450,6 +456,37 @@ class ThreadExchangeShuffler:
         """Completed exchange rounds — the public counter checkpoints
         read (``LoaderCheckpoint.capture``)."""
         return self._round
+
+    @property
+    def exchange_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend_exchange(self) -> None:
+        """Cross-host ladder rung: degrade every round to the seeded
+        node-local shuffle until :meth:`resume_exchange` (a cluster view
+        change removed an exchange peer's host; docs/ROBUSTNESS.md).
+        Idempotent; the round counter keeps advancing so checkpoints
+        and the eventual resume stay schedule-coherent."""
+        if not self._suspended:
+            self._suspended = True
+            self.metrics.incr("shuffle.suspensions")
+            logger.warning(
+                "global shuffle: exchange SUSPENDED (cluster view "
+                "change) — shuffling node-locally until rejoin"
+            )
+
+    def resume_exchange(self) -> None:
+        """Exit the suspension rung (host rejoined at a new epoch
+        fence).  The consecutive-loss ladder restarts clean — losses
+        counted against the pre-suspension view prove nothing about the
+        rejoined one."""
+        if self._suspended:
+            self._suspended = False
+            self._peer_losses = 0
+            self.metrics.incr("shuffle.resumes")
+            logger.warning(
+                "global shuffle: exchange RESUMED at round %d", self._round
+            )
 
     def rejoin(self, round_: int) -> None:
         """Re-enter the exchange schedule at ``round_`` (elastic rejoin:
@@ -497,9 +534,13 @@ class ThreadExchangeShuffler:
         me = self.topology.instance_idx
         if n <= 1 or self.num_exchange < 2:
             return
-        if self._degraded:
-            # Terminal rung reached earlier: keep mixing locally, keep
-            # the round counter advancing (checkpoints stay coherent).
+        if self._degraded or self._suspended:
+            # Terminal rung (repeated peer loss) or the reversible
+            # cluster-suspension rung: keep mixing locally, keep the
+            # round counter advancing (checkpoints and the eventual
+            # resume stay schedule-coherent).
+            if self._suspended:
+                self.metrics.incr("shuffle.suspended_rounds")
             self._local_shuffle(my_ary)
             self._round += 1
             return

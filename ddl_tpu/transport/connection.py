@@ -59,6 +59,14 @@ class ControlChannel(abc.ABC):
         """
         return NOTHING  # pragma: no cover - overridden by real channels
 
+    def alive(self) -> bool:
+        """Best-effort channel liveness: False only when the link is
+        POSITIVELY known dead (closed fd / broken pipe).  The cluster
+        membership layer (``ddl_tpu.cluster``) layers host heartbeats
+        over this — a channel that cannot say is presumed alive, and
+        lease EXPIRY (never a single probe) declares the loss."""
+        return True
+
     def close(self) -> None:  # pragma: no cover
         pass
 
@@ -126,6 +134,12 @@ class PipeChannel(ControlChannel):
             # Peer gone: the blocking paths / ring flag own that failure
             # mode; the poll stays quiet rather than double-reporting.
             return NOTHING
+
+    def alive(self) -> bool:
+        try:
+            return not self._conn.closed
+        except (OSError, AttributeError):
+            return False
 
     def close(self) -> None:
         self._conn.close()
@@ -301,6 +315,17 @@ class ConsumerConnection:
         # surviving ring is untouched by the producer's death.
         return reply
 
+    def send_control(self, target: int, msg: Any) -> None:
+        """Send a control-plane message to producer ``target`` (0-based
+        ring index) under the rejoin lock — concurrent senders (the
+        consumer's replay requests, the cluster ladder's shard
+        adoptions on the watchdog thread) must serialize against each
+        other AND against an in-flight elastic channel swap, or two
+        writes interleave on one pipe / a send lands on a
+        closed-but-unswapped channel."""
+        with self._lock:
+            self.channels[target].send(msg)
+
     def request_replay(self, target: int, seq: int) -> None:
         """Ask producer ``target`` (0-based ring index) to rewind and
         re-commit its window stream from logical window ``seq``
@@ -309,8 +334,7 @@ class ConsumerConnection:
         consistent channel list."""
         from ddl_tpu.types import ReplayRequest
 
-        with self._lock:
-            self.channels[target].send(ReplayRequest(seq=seq))
+        self.send_control(target, ReplayRequest(seq=seq))
 
     def shutdown_operation(self) -> None:
         """Wake every producer with the shutdown flag.

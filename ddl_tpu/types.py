@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import TYPE_CHECKING, Any, Sequence
+from typing import TYPE_CHECKING, Any, Optional, Sequence
 
 import numpy as np
 
@@ -111,6 +111,30 @@ class ReplayRequest:
     seq: int
 
 
+@dataclasses.dataclass
+class ShardAdoption:
+    """Consumer → producer: adopt shard ``ranges`` as of cluster view
+    ``view_epoch`` (cross-host elastic recovery, :mod:`ddl_tpu.cluster`).
+
+    Sent over the control channel when a view change re-partitions a
+    dead host's shard range across survivors.  ``ranges`` is the
+    receiving producer's HOST-level range list (``(start, stop)``
+    half-open shard-index pairs); ``peer_idx``/``n_peers`` locate the
+    producer among its host's loader ranks so multi-producer hosts can
+    subdivide.  ``suspend_exchange`` rides along: ``True`` degrades the
+    cross-instance shuffle to node-local until rejoin (the documented
+    ladder rung), ``False`` resumes it, ``None`` leaves it alone.
+    Stale epochs (``view_epoch`` <= the last applied one) are ignored by
+    the producer — view changes are fenced, never reordered.
+    """
+
+    ranges: tuple
+    view_epoch: int
+    peer_idx: int = 0
+    n_peers: int = 1
+    suspend_exchange: Optional[bool] = None
+
+
 @dataclasses.dataclass(frozen=True)
 class Topology:
     """Process/worker topology — the TPU-native replacement for ``MPI_Env``.
@@ -133,6 +157,13 @@ class Topology:
     instance_idx: int = 0
     n_producers: int = 2
     mode: RunMode = RunMode.THREAD
+    #: Physical host identity (``ddl_tpu.cluster``): with multiple
+    #: consumer processes per host (e.g. one per chip on a multi-chip
+    #: host), ``instance_idx`` over-counts hosts — the membership view
+    #: and placement engine need REAL host boundaries.  Defaults keep
+    #: the historical one-consumer-per-host reading.
+    host_id: int = 0
+    n_hosts: int = 1
 
     def __post_init__(self) -> None:
         if self.n_instances < 1 or self.n_producers < 1:
@@ -142,6 +173,14 @@ class Topology:
             )
         if not (0 <= self.instance_idx < self.n_instances):
             raise ValueError(f"{self.instance_idx=} out of range")
+        if self.n_hosts < 1 or not (0 <= self.host_id < self.n_hosts):
+            raise ValueError(
+                f"{self.host_id=} out of range for {self.n_hosts=}"
+            )
+        # n_hosts may legitimately EXCEED n_instances: a single-host
+        # THREAD/PROCESS run launched inside a multi-node allocation
+        # still knows it is node k of N, and MPMD-style loader-only
+        # hosts carry no consumer process at all (ddl_tpu.cluster).
 
     @property
     def world_size(self) -> int:

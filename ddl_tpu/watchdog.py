@@ -40,6 +40,7 @@ class Watchdog:
         max_respawns: int = 3,
         replay_budget_per_window_s: float = 1.0,
         metrics: Optional[Metrics] = None,
+        cluster: Any = None,
     ):
         """``respawn=True`` turns detection into recovery: a dead
         producer worker is replaced in place (``WorkerSet.respawn`` —
@@ -47,6 +48,14 @@ class Watchdog:
         position) up to ``max_respawns`` times before falling back to
         ``on_failure``.  The reference had neither detection nor
         recovery (SURVEY §5.3).
+
+        ``cluster`` (a :class:`ddl_tpu.cluster.ClusterSupervisor`)
+        extends the ladder cross-host: every poll also drives one
+        membership sweep from this monitor thread, and workers whose
+        HOST has left the view are the cluster ladder's to handle — the
+        watchdog neither respawns them (a replacement would rejoin a
+        ring the loader pool already dropped) nor escalates them to
+        ``on_failure`` (the view change IS the handling).
 
         Recovery events record into ``metrics`` (``watchdog.respawns``,
         ``watchdog.failures``) so robustness regressions are visible in
@@ -59,6 +68,7 @@ class Watchdog:
         self.respawn = respawn
         self.max_respawns = max_respawns
         self.replay_budget_per_window_s = replay_budget_per_window_s
+        self.cluster = cluster
         self.metrics = metrics or default_metrics()
         self.respawns: List[int] = []  # producer_idx per respawn event
         self._stop = threading.Event()
@@ -122,16 +132,30 @@ class Watchdog:
         ):
             return None
         self._dead_idx = None
+        # Workers of hosts that LEFT the cluster view are the host-level
+        # ladder's to handle (ddl_tpu.cluster): a view change declared
+        # them, the loader pool dropped their rings, and survivors
+        # adopted their shard ranges — dead-by-design, not failures.
+        lost = (
+            self.cluster.lost_ranks() if self.cluster is not None
+            else frozenset()
+        )
         for i, t in enumerate(self.workers.threads):
+            if i + 1 in lost:
+                continue
             if not t.is_alive():
                 self._dead_idx = i + 1
                 return f"producer thread {i + 1} died"
         for i, p in enumerate(self.workers.processes):
+            if i + 1 in lost:
+                continue
             if p.exitcode is not None and p.exitcode != 0:
                 self._dead_idx = i + 1
                 return f"producer process {i + 1} exited with {p.exitcode}"
         now = time.monotonic()
         for i, ring in enumerate(rings):
+            if i + 1 in lost:
+                continue  # no progress expected from a departed host
             st = ring.stats()
             progress = (st["committed"], st["released"])
             if (
@@ -179,6 +203,19 @@ class Watchdog:
         # Workers that already exited cleanly (end of run) are expected;
         # only flag failures while the pipeline is supposed to be live.
         while not self._stop.wait(self.poll_interval_s):
+            if self.cluster is not None:
+                # Host-level ladder: one membership sweep per poll from
+                # this monitor thread (lease refresh from liveness
+                # sources, expiry → epoch-fenced view change).  Same
+                # crash discipline as check_once below.
+                try:
+                    self.cluster.sweep()
+                except (ShutdownRequested, KeyboardInterrupt):
+                    return
+                except Exception:
+                    logger.exception(
+                        "watchdog: cluster sweep raised; continuing"
+                    )
             try:
                 reason = self.check_once()
             except (ShutdownRequested, KeyboardInterrupt):
@@ -191,6 +228,14 @@ class Watchdog:
                 logger.exception("watchdog: check_once raised; continuing")
                 continue
             if reason is not None:
+                if (
+                    self.cluster is not None
+                    and self._dead_idx is not None
+                    and self._dead_idx in self.cluster.lost_ranks()
+                ):
+                    # Declared dead at host level between check_once and
+                    # here: the view change owns it.
+                    continue
                 if (
                     self.respawn
                     and self._dead_idx is not None
@@ -207,6 +252,12 @@ class Watchdog:
                         self.workers.respawn(idx)
                         self.respawns.append(idx)
                         self.metrics.incr("watchdog.respawns")
+                        if self.cluster is not None:
+                            # Cross-host ladder: the fresh incarnation
+                            # must hear the CURRENT view's shard
+                            # assignment (an adoption sent while the
+                            # dead channel was mid-swap is lost).
+                            self.cluster.rank_respawned(idx)
                         # Stall clock restarts at the respawn; the
                         # widened replay budget holds until the
                         # committed count moves past its current value.

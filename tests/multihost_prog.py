@@ -20,7 +20,13 @@ constants stay consistent across the pickle boundary):
   (zero-copy global window stream), ``packed`` (packed-segment stream
   fit), ``dpsp`` (loader feeding a dp×sp global mesh, ring attention
   over sp), ``ckpt`` (checkpoint → fresh-state restore → loader
-  fast-forward resume on a shared dir, ``DDL_MH_DIR``).
+  fast-forward resume on a shared dir, ``DDL_MH_DIR``), ``ppdp``
+  (loader feeding a pp×dp global mesh — pipelined llama loss over pp,
+  dp gradient psum across hosts), ``dpep`` (loader feeding a dp×ep
+  global mesh — MoE expert weights sharded over ep), ``chaos`` (the
+  cross-host elastic leg: producer crash + whole-mock-host kill in
+  process 1 mid-run while every process's collectives continue and the
+  stream recovers byte-correct — ROADMAP item 3 / ISSUE 10).
 
 Usage: python multihost_prog.py <process_id> <coordinator_address>
 """
@@ -75,6 +81,52 @@ class TaggedProducer(ProducerFunctionSkeleton):
 
 SP_SEQ = 16
 
+# ---- chaos-leg geometry (module level: pickled to spawned workers) -----
+CH_SHARDS, CH_ROWS, CH_VALS = 4, 8, 4
+
+
+def chaos_pattern(instance_idx: int, shard: int) -> np.ndarray:
+    """Byte-deterministic content of one (instance, shard) window."""
+    return (
+        instance_idx * 100_000.0
+        + shard * 1000.0
+        + np.arange(CH_ROWS * CH_VALS, dtype=np.float32) % 97
+    ).reshape(CH_ROWS, CH_VALS)
+
+
+class ChaosShardProducer(ProducerFunctionSkeleton):
+    """Serves its mock host's shard ranges in a cycle; ``adopt_shards``
+    re-partitions mid-run (the cross-host elastic leg's producer)."""
+
+    def __init__(self, instance_idx: int, ranges_by_producer):
+        self.instance_idx = instance_idx
+        self.ranges_by_producer = dict(ranges_by_producer)
+        self.ranges = ()
+
+    def _shards(self):
+        return [s for a, b in self.ranges for s in range(a, b)]
+
+    def on_init(self, producer_idx=1, **kw):
+        self.it = 0
+        self.ranges = tuple(self.ranges_by_producer[producer_idx])
+        return DataProducerOnInitReturn(
+            nData=CH_ROWS, nValues=CH_VALS, shape=(CH_ROWS, CH_VALS),
+            splits=(CH_VALS,),
+        )
+
+    def post_init(self, my_ary, **kw):
+        my_ary[:] = 0.0
+
+    def execute_function(self, my_ary, **kw):
+        shards = self._shards()
+        my_ary[:] = chaos_pattern(
+            self.instance_idx, shards[self.it % len(shards)]
+        )
+        self.it += 1
+
+    def adopt_shards(self, ranges, **kw):
+        self.ranges = tuple(ranges)
+
 
 class TokenProducer(ProducerFunctionSkeleton):
     """int32 token rows for the dp×sp leg (module-level: picklable)."""
@@ -101,6 +153,14 @@ def main(process_id: int, coordinator: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Cross-process computations on the CPU backend need the gloo
+    # collectives implementation (jax >= 0.4.34; without it every
+    # multi-process jit fails with "Multiprocess computations aren't
+    # implemented on the CPU backend").
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # flag absent on this jax: single-process-era behavior
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=N_PROCESSES,
@@ -277,6 +337,228 @@ def main(process_id: int, coordinator: str) -> None:
             assert losses and all(np.isfinite(l) for l in losses)
 
         run_dpsp()
+
+    # ---- pp×dp global mesh fed by the loader (ROADMAP item 3) ----------
+    # Pipeline parallelism ACROSS the virtual-mesh matrix: the pipelined
+    # llama loss runs its ppermute ring over the pp axis while the dp
+    # gradient psum crosses hosts, fed per host by the loader.
+    if "ppdp" in LEGS:
+        total = N_PROCESSES * DEVICES_PER_PROCESS
+        assert total % 2 == 0, "ppdp leg needs an even global device count"
+        ppcfg = _llama_mod.LlamaConfig(
+            vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq=SP_SEQ, dtype=jax.numpy.float32,
+        )
+
+        @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+        def run_ppdp(env):
+            # dp OUTER (spans processes — each host contributes distinct
+            # batch rows), pp inner (the ppermute ring stays host-local).
+            mesh = make_mesh({"dp": total // 2, "pp": 2})
+            init_fn, step_fn = make_train_step(
+                lambda p, b: _llama_mod.next_token_loss_pp(
+                    p, b[0], ppcfg, mesh, n_microbatches=2
+                ),
+                optax.sgd(1e-2), mesh,
+                _llama_mod.pp_param_specs(ppcfg),
+                batch_spec=P(("dp",)),
+            )
+            state = init_fn(
+                _llama_mod.stage_params(
+                    _llama_mod.init_params(ppcfg, jax.random.key(0)), 2
+                )
+            )
+            loader = DistributedDataLoader(
+                TokenProducer(), batch_size=BATCH,
+                connection=env.connection, n_epochs=2, output="numpy",
+            )
+            losses = []
+            for _epoch in range(2):
+                for (tok,) in loader:
+                    gtok = make_global_array(
+                        tok, NamedSharding(mesh, P(("dp",)))
+                    )
+                    assert gtok.shape == (N_PROCESSES * BATCH, SP_SEQ)
+                    state, loss = step_fn(state, (gtok,))
+                    losses.append(float(loss))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            assert losses and all(np.isfinite(l) for l in losses)
+
+        run_ppdp()
+
+    # ---- dp×ep global mesh fed by the loader (ROADMAP item 3) ----------
+    # Expert parallelism across hosts: MoE expert weights shard over the
+    # ep axis while dp carries the loader's global batch.
+    if "dpep" in LEGS:
+        from ddl_tpu.models import moe as _moe_mod
+
+        total = N_PROCESSES * DEVICES_PER_PROCESS
+        assert total % 2 == 0, "dpep leg needs an even global device count"
+        epcfg = _moe_mod.MoeConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=32, n_experts=2, topk=1, max_seq=SP_SEQ,
+            dtype=jax.numpy.float32,
+        )
+
+        @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+        def run_dpep(env):
+            mesh = make_mesh({"dp": total // 2, "ep": 2})
+            init_fn, step_fn = make_train_step(
+                lambda p, b: _moe_mod.next_token_loss(
+                    p, b[0], epcfg, mesh=mesh
+                ),
+                optax.sgd(1e-2), mesh, _moe_mod.param_specs(epcfg),
+                batch_spec=P(("dp",)),
+            )
+            state = init_fn(_moe_mod.init_params(epcfg, jax.random.key(0)))
+            loader = DistributedDataLoader(
+                TokenProducer(), batch_size=BATCH,
+                connection=env.connection, n_epochs=2, output="numpy",
+            )
+            losses = []
+            for _epoch in range(2):
+                for (tok,) in loader:
+                    gtok = make_global_array(
+                        tok, NamedSharding(mesh, P(("dp",)))
+                    )
+                    state, loss = step_fn(state, (gtok,))
+                    losses.append(float(loss))
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            assert losses and all(np.isfinite(l) for l in losses)
+            # Expert weights actually sharded over ep on the GLOBAL mesh.
+            assert "ep" in str(
+                state.params["layers"][0]["w_gate"].sharding.spec
+            )
+
+        run_dpep()
+
+    # ---- cross-host elastic chaos leg (ROADMAP item 3 / ISSUE 10) ------
+    # Process 1 loses a producer (rung 1: watchdog respawn) and then a
+    # WHOLE mock host (rung 2: epoch-fenced view change → loader-pool
+    # shrink → shard adoption) mid-run, while every process — process 0
+    # above all — keeps running a global collective per window and the
+    # recovered stream serves byte-correct full-shard coverage.
+    if "chaos" in LEGS:
+        from ddl_tpu import faults as faults_mod
+        from ddl_tpu.cluster import (
+            ClusterSupervisor,
+            ClusterView,
+            ElasticCluster,
+            HostInfo,
+        )
+        from ddl_tpu.faults import FaultKind, FaultPlan, FaultSpec
+        from ddl_tpu.watchdog import Watchdog
+
+        CH_EPOCHS = 12
+        me = jax.process_index()
+        # Rung 1's trigger, armed (and exported across the producer
+        # spawn boundary) only in process 1: producer 1 of host A
+        # crashes on its 3rd fill and the watchdog respawns it.
+        chaos_plan = None
+        if me == 1:
+            chaos_plan = FaultPlan(
+                [FaultSpec("producer.fill", FaultKind.PRODUCER_CRASH,
+                           at=3, producer_idx=1)]
+            )
+            faults_mod.arm(chaos_plan, export=True)
+
+        producer = ChaosShardProducer(me, {1: ((0, 2),), 2: ((2, 4),)})
+
+        @distributed_dataloader(n_producers=N_PRODUCERS, mode="multihost")
+        def run_chaos(env):
+            # The original producers (spawned at decorator entry) carry
+            # the exported plan; dropping it from the env NOW makes the
+            # crash fire in exactly ONE incarnation — a respawned
+            # replacement re-arms from the env at import and would
+            # otherwise crash at ITS 3rd fill too, forever.
+            os.environ.pop(faults_mod.PLAN_ENV, None)
+            # Two LOCAL mock hosts per process, one producer each; host
+            # ids are globally distinct (host identity, not instance).
+            host_a, host_b = 2 * me, 2 * me + 1
+            view = ClusterView.bootstrap(
+                [
+                    HostInfo(host_a, loader_ranks=(1,), trainer_ranks=(me,)),
+                    HostInfo(host_b, loader_ranks=(2,)),
+                ],
+                n_shards=CH_SHARDS,
+            )
+            # Long lease: this leg's host death is DECLARED (kill_host);
+            # rung 1's crash-respawn gap must never expire a lease.
+            sup = ClusterSupervisor(view, lease_s=120.0)
+            elastic = ElasticCluster(sup, workers=env.workers)
+            loader = DistributedDataLoader(
+                producer, batch_size=CH_ROWS, connection=env.connection,
+                n_epochs=CH_EPOCHS, output="numpy", timeout_s=120.0,
+                cluster=elastic,
+            )
+            wd = Watchdog(
+                env.workers, poll_interval_s=0.1, stall_budget_s=60.0,
+                respawn=True, cluster=sup,
+            ).start()
+            mesh = make_mesh({"dp": N_PROCESSES * DEVICES_PER_PROCESS})
+            repl = NamedSharding(mesh, P())
+            gather = jax.jit(lambda x: x, out_shardings=repl)
+            ones_sh = NamedSharding(mesh, P(("dp",)))
+            seen = {}
+            try:
+                for ep in range(CH_EPOCHS):
+                    for (win,) in loader:
+                        tag = float(win[0, 0])
+                        inst, shard = int(tag // 100_000), int(
+                            (tag % 100_000) // 1000
+                        )
+                        assert inst == me, (inst, me)
+                        np.testing.assert_array_equal(
+                            win, chaos_pattern(me, shard),
+                            err_msg=f"shard {shard} epoch {ep}",
+                        )
+                        seen.setdefault(shard, 0)
+                        seen[shard] += 1
+                        # THE collective: every process contributes its
+                        # device rows and the global sum must land on
+                        # every host, every window — including while
+                        # process 1 is mid-recovery.
+                        block = np.ones(
+                            (DEVICES_PER_PROCESS, 1), np.float32
+                        )
+                        total = float(
+                            np.asarray(
+                                gather(make_global_array(block, ones_sh))
+                            ).sum()
+                        )
+                        assert total == N_PROCESSES * DEVICES_PER_PROCESS
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+                    if me == 1 and ep == 5:
+                        # Rung 2: the whole mock host B dies.
+                        elastic.kill_host(host_b)
+            finally:
+                wd.stop()
+            if me == 1:
+                # Both rungs landed: a respawn AND a host loss, with the
+                # watchdog never escalating to on_failure (which aborts).
+                # (The crash itself fires in the spawned producer's
+                # re-armed plan copy — the consumer-side observable is
+                # the respawn it forced.)
+                from ddl_tpu.observability import metrics as dm
+
+                assert dm().counter("watchdog.respawns") >= 1, (
+                    "rung-1 crash/respawn never happened"
+                )
+                assert dm().counter("watchdog.failures") == 0
+                assert dm().counter("cluster.host_losses") == 1
+                assert sup.view.epoch == 1
+                # Post-adoption the survivor serves host B's shards too:
+                # full byte-correct coverage despite losing the host.
+                assert sorted(seen) == list(range(CH_SHARDS)), seen
+            else:
+                assert sorted(seen) == list(range(CH_SHARDS)), seen
+
+        run_chaos()
+        if me == 1:
+            faults_mod.arm(None, export=True)
 
     # ---- checkpoint → restore → resume on a shared dir (item 6) --------
     # The multihost round trip: every process participates in one Orbax

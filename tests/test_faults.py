@@ -420,6 +420,52 @@ class TestFaultMatrix:
             main()
         assert time.monotonic() - t0 < 30.0
 
+    def test_host_loss_repartitions_byte_identical(self):
+        """HOST_LOSS at cluster.heartbeat (ISSUE 10): the injected loss
+        of a whole mock host drives the epoch-fenced view change — pool
+        shrink, shard adoption — and the stream recovers byte-identical
+        full-shard coverage (the runner lives in tests/test_cluster.py;
+        the matrix row wires it into the tier-1 chaos sweep)."""
+        from test_cluster import (
+            assert_full_coverage_byte_identical,
+            drain_cluster,
+        )
+
+        plan = FaultPlan(
+            # at=8: past bootstrap sweeps, mid-stream (50 ms cadence).
+            [FaultSpec("cluster.heartbeat", FaultKind.HOST_LOSS,
+                       at=8, producer_idx=1)]
+        )
+        seen, m, sup = drain_cluster(plan=plan, n_epochs=24, pace_s=0.05)
+        assert plan.fired, "HOST_LOSS spec never fired"
+        assert m.counter("cluster.host_losses") == 1.0
+        assert m.counter("cluster.view_changes") == 1.0
+        assert m.counter("watchdog.failures") == 0.0
+        assert_full_coverage_byte_identical(seen)
+
+    def test_heartbeat_drop_expires_lease_then_recovers(self):
+        """Persistent HEARTBEAT_DROP at cluster.heartbeat: single drops
+        are absorbed (only the lease ages), but a host whose every beat
+        is lost expires and leaves the view — the stream re-partitions
+        and completes with full coverage."""
+        from test_cluster import (
+            assert_full_coverage_byte_identical,
+            drain_cluster,
+        )
+
+        plan = FaultPlan(
+            [FaultSpec("cluster.heartbeat", FaultKind.HEARTBEAT_DROP,
+                       producer_idx=1, count=100_000)]
+        )
+        seen, m, sup = drain_cluster(
+            plan=plan, n_epochs=24, lease_s=0.4, pace_s=0.05
+        )
+        assert plan.fired
+        assert m.counter("cluster.heartbeats_dropped") > 1.0
+        assert m.counter("cluster.host_losses") == 1.0
+        assert m.counter("watchdog.failures") == 0.0
+        assert_full_coverage_byte_identical(seen)
+
 
 # ---------------------------------------------------------------------------
 # Engine mechanics: determinism, matching, serialization, zero-cost.

@@ -207,6 +207,11 @@ class TestNorthStarReport:
             "opt_state_bytes_per_replica", "opt_state_bytes_total",
             "opt_grad_comm_bytes_raw", "opt_grad_comm_bytes_quantized",
             "opt_gather_s", "opt_scatter_s",
+            # multi-host control plane extras (ISSUE 10:
+            # ddl_tpu/cluster — membership churn + ladder actions)
+            "view_changes", "host_losses", "host_rejoins",
+            "heartbeats_dropped", "shard_adoptions",
+            "cluster_cache_adoptions", "pool_updates",
         }
         assert r["samples_per_sec"] > 0
 

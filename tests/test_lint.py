@@ -184,6 +184,15 @@ VIOLATIONS = {
 
             return jax.jit(apply_step)   # params + opt state undonated
     """,
+    "DDL018": """
+        import time
+
+        class ClusterSupervisor:
+            def run(self):
+                while self._live:
+                    self.sweep()         # no deadline, no lease expiry
+                    time.sleep(0.5)
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -398,6 +407,28 @@ CLEAN = {
         def helper_outside_builders(fn):
             return jax.jit(fn)   # not a configured train-step builder
     """,
+    "DDL018": """
+        import time
+
+        class ClusterSupervisor:
+            def run(self):
+                deadline = time.monotonic() + self.budget_s
+                while time.monotonic() < deadline:   # bounded sweep loop
+                    self.sweep()
+
+            def _run(self):
+                while not self._stop.wait(self.poll_interval_s):
+                    self.sweep()   # timed stop-event wait bounds it
+
+            def wait_for_epoch(self, epoch):
+                while self.view.epoch < epoch:
+                    if self.leases.expired():   # lease query bounds it
+                        break
+
+        def helper_outside_cluster(sup):
+            while True:
+                sup.sweep()   # not a configured cluster loop
+    """,
 }
 
 
@@ -589,6 +620,45 @@ class TestSelfTest:
         """
         findings = lint_snippet(tmp_path, "DDL017", src)
         assert findings == [], findings
+
+    def test_ddl018_respects_configured_cluster_loop_list(self, tmp_path):
+        """A loop outside cluster_loop_functions stays clean (the check
+        is repo policy, not a global while-loop ban), and the deadline/
+        lease vocabulary is what licenses a configured one."""
+        src = """
+            class CustomPlane:
+                def pump(self):
+                    while self._live:
+                        self._drain_once()
+        """
+        cfg = LintConfig(cluster_loop_functions=["OtherPlane.pump"])
+        findings = lint_snippet(tmp_path, "DDL018", src, config=cfg)
+        assert findings == [], findings
+        cfg = LintConfig(cluster_loop_functions=["CustomPlane.pump"])
+        findings = lint_snippet(tmp_path, "DDL018", src, config=cfg)
+        assert [f.code for f in findings] == ["DDL018"]
+
+    def test_ddl018_timed_wait_and_lease_query_pass(self, tmp_path):
+        """The two sanctioned bounding idioms the shipped supervisor
+        uses: a timed stop-event wait, and a lease-table query; a
+        deadline-free spin in the same configured class still fires."""
+        src = """
+            class ClusterSupervisor:
+                def run(self):
+                    while not self._stop.wait(self.poll_interval_s):
+                        self.sweep()
+
+                def _run(self):
+                    while self.leases.expired() == []:
+                        self.sweep()
+
+                def wait_for_epoch(self, epoch):
+                    while self.view.epoch < epoch:
+                        self._spin_hint()   # unbounded: spins forever
+        """
+        findings = lint_snippet(tmp_path, "DDL018", src)
+        assert [f.code for f in findings] == ["DDL018"]
+        assert "wait_for_epoch" in findings[0].message
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

@@ -95,3 +95,26 @@ def test_four_process_two_devices_each(tmp_path):
         4, 2, "core,dpsp",
         timeout_s=_TIMEOUT_S + 180,
     )
+
+
+@pytest.mark.slow
+@cross_process_ring
+def test_virtual_mesh_matrix_ppdp_dpep():
+    """ROADMAP item 3's virtual-mesh matrix: the loader feeding a pp×dp
+    global mesh (pipelined llama loss over pp, dp grad psum across
+    hosts) and a dp×ep global mesh (MoE expert weights sharded over
+    ep), 2 hosts × 2 devices each."""
+    _run_cluster(2, 2, "ppdp,dpep", timeout_s=_TIMEOUT_S + 180)
+
+
+@cross_process_ring
+def test_cross_host_elastic_chaos():
+    """The cross-host elastic leg, tier-1 (ISSUE 10 acceptance): in
+    process 1 a producer crashes mid-run (watchdog respawn, rung 1) and
+    then a whole mock host is killed (epoch-fenced view change → pool
+    shrink → shard adoption, rung 2), while process 0's — and process
+    1's own — global collectives continue every window and the stream
+    recovers byte-correct full-shard coverage.  Minimal geometry (2
+    processes × 2 devices — the proven multihost shape — and no model)
+    keeps it inside the tier-1 budget."""
+    _run_cluster(2, 2, "chaos", timeout_s=_TIMEOUT_S)

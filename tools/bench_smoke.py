@@ -29,6 +29,12 @@ Four passes:
    shrink >= MIN_STATE_SHRINK, the quantized grad-comm payload must
    undercut raw, and the recorded winner must be the faster of the
    zero1/replicated pair the same run measured (never-slower).
+2c. `DDL_BENCH_MODE=placement` — the topology-aware vs naive placement
+   A/B block must carry its contract keys, the measured ratio must be
+   >= 1.0 (the naive order is always a candidate plan — never-slower),
+   the winner label must name the measured winner, and the membership
+   counters must show the injected HOST_LOSS drove a real epoch-fenced
+   view change (`view_changes`/`host_losses` >= 1).
 3. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
    schedule/bubble gauges) and its `pipeline_overhead` against the
@@ -136,6 +142,23 @@ REQUIRED_OPT = (
 #: factor (the measured shrink is ~dp — 4.0 on the dp=4 smoke mesh —
 #: so 1.5 is noise-proof while still catching a sharding regression).
 MIN_STATE_SHRINK = 1.5
+#: The placement block's contract (ISSUE 10: DDL_BENCH_MODE=placement —
+#: topology-aware vs naive producer→consumer assignment over the
+#: simulated fabric).  ``bytes_per_s`` must be the measured WINNER of
+#: the pair (never-headline-slower), the measured ``ratio`` must be
+#: >= MIN_PLACEMENT_RATIO (the naive order is always a candidate plan,
+#: so topology-aware can never lose by more than noise), and the
+#: membership chaos counters must show the injected host loss drove a
+#: real epoch-fenced view change.
+REQUIRED_PLACEMENT = (
+    "bytes_per_s", "naive_bytes_per_s", "topo_bytes_per_s", "ratio",
+    "modeled_ratio", "winner", "reordered", "n_hosts", "n_links",
+    "cost_source", "payload_bytes", "view_changes", "host_losses",
+)
+#: Floor for the measured topology/naive ratio: the island geometry's
+#: true win is ~4-8x, so 1.0 only catches a never-slower violation
+#: (one retry absorbs one-sided box noise).
+MIN_PLACEMENT_RATIO = 1.0
 
 
 def _run_bench(mode: str) -> "dict | None":
@@ -429,6 +452,73 @@ def main() -> int:
             f"{opt['grad_comm_bytes_raw']}"
         )
         return 1
+    # -- pass 2c: topology-aware placement + membership (ISSUE 10) -----
+    for attempt in range(1, 3):
+        pl_result = _run_bench("placement")
+        if pl_result is None:
+            return 1
+        pl = pl_result.get("placement")
+        if not isinstance(pl, dict):
+            print(json.dumps(pl_result, indent=1))
+            print(
+                "bench-smoke: no placement block "
+                f"(errors={pl_result.get('errors')})"
+            )
+            return 1
+        pl_missing = [k for k in REQUIRED_PLACEMENT if k not in pl]
+        if pl_missing:
+            print(json.dumps(pl, indent=1))
+            print(f"bench-smoke: placement block missing keys: {pl_missing}")
+            return 1
+        pl_pair = {
+            "naive": pl["naive_bytes_per_s"],
+            "topology": pl["topo_bytes_per_s"],
+        }
+        pl_problems = []
+        if pl["bytes_per_s"] < max(pl_pair.values()):
+            pl_problems.append(
+                f"placement headline {pl['bytes_per_s']} is slower than "
+                f"an assignment the same run measured ({pl_pair}) — "
+                "never-slower invariant violated"
+            )
+        if pl["ratio"] < MIN_PLACEMENT_RATIO:
+            pl_problems.append(
+                f"measured topology/naive ratio {pl['ratio']} < "
+                f"{MIN_PLACEMENT_RATIO} — the naive order is always a "
+                "candidate plan, so topology-aware may never lose"
+            )
+        if (
+            pl["winner"] != max(pl_pair, key=pl_pair.get)
+            or pl_result.get("headline_config") != pl["winner"]
+        ):
+            pl_problems.append(
+                f"placement winner label {pl['winner']!r} / "
+                f"headline_config {pl_result.get('headline_config')!r} "
+                f"do not name the measured winner ({pl_pair})"
+            )
+        if not pl_problems:
+            break
+        if attempt < 2:
+            print(
+                f"bench-smoke: placement gates failed ({pl_problems}); "
+                "retrying once (one-sided box noise)"
+            )
+            continue
+        print(json.dumps(pl, indent=1))
+        for p in pl_problems:
+            print(f"bench-smoke: {p}")
+        return 1
+    # The chaos counters are deterministic (a seeded HOST_LOSS through a
+    # real supervisor sweep) — never retried.
+    if pl["view_changes"] < 1 or pl["host_losses"] < 1:
+        print(json.dumps(pl, indent=1))
+        print(
+            "bench-smoke: placement membership counters show no view "
+            f"change (view_changes={pl['view_changes']}, "
+            f"host_losses={pl['host_losses']}) — the injected HOST_LOSS "
+            "did not drive the control plane"
+        )
+        return 1
     # -- pass 3: the training hot path (ISSUE 5) -----------------------
     overheads = []
     for attempt in range(1, FIT_ATTEMPTS + 1):
@@ -482,6 +572,8 @@ def main() -> int:
         f"opt winner {opt['winner']} vs_replicated "
         f"{opt['vs_replicated']} parity (drift fp32 {opt['loss_drift']} "
         f"int8 {opt['int8_loss_drift']}) state {opt['state_shrink']}x; "
+        f"placement winner {pl['winner']} ratio {pl['ratio']} "
+        f"(view_changes={pl['view_changes']}); "
         "fit_stream overhead "
         f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "
         f"(window_wait_s={fit['window_wait_s']})"

@@ -2,6 +2,7 @@
 
 from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     caches,
+    cluster_loops,
     concurrency,
     device_path,
     ingest_path,

@@ -1,0 +1,133 @@
+"""Cluster control-plane loop invariants.
+
+The membership layer (``ddl_tpu/cluster``) is made of retry/heartbeat
+loops by nature — sweeps, lease refreshes, bootstrap barriers, link
+probes.  An unbounded one is the exact failure class the control plane
+exists to eliminate: a supervisor spinning on a host that will never
+beat again is a dead host taking the MONITOR down with it.  Repo rule
+(docs/LINT.md DDL018): every loop in a configured cluster control-plane
+function must consult a **deadline or lease expiry** — a monotonic-
+clock comparison, a ``deadline``/``lease``/``timeout``/expiry value, an
+``expired()``/``remaining()`` lease query, or a timed ``.wait(...)`` on
+a stop event.  Observing shutdown alone (DDL004's bar) is NOT enough
+here: shutdown wakes a loop whose run is ending, but only a deadline
+bounds a loop whose PEER is gone while the run must continue.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Calls that consult a clock or a lease directly.
+_CLOCK_CALLS = {"monotonic", "perf_counter", "time"}
+_LEASE_CALLS = {"expired", "remaining"}
+#: Name fragments that mark a deadline/lease value being consulted.
+_DEADLINE_NAME_PARTS = ("deadline", "lease", "timeout", "expir")
+
+
+def _walk_no_defs(root: ast.AST):
+    """Walk a subtree without descending into nested function/class
+    defs (a nested def's loops are checked when IT is configured)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+@register
+class ClusterLoopDeadline(Checker):
+    """DDL018: cluster control-plane loops must consult a deadline or
+    lease expiry.
+
+    Functions named in ``[tool.ddl_lint] cluster_loop_functions`` (bare
+    names or ``Class.method``) implement the membership/recovery
+    machinery.  Every ``while`` loop inside one must, in its test or
+    body, do at least one of:
+
+    - compare against a monotonic clock (``time.monotonic()`` /
+      ``perf_counter()``),
+    - consult a deadline-ish value (a name containing ``deadline`` /
+      ``lease`` / ``timeout`` / ``expir``),
+    - query the lease table (``.expired(...)`` / ``.remaining(...)``),
+    - block on a TIMED wait (``.wait(...)`` with an argument or a
+      ``timeout=`` keyword — the stop-event idiom).
+
+    Escape hatch: ``# ddl-lint: disable=DDL018`` with a rationale.
+    """
+
+    code = "DDL018"
+    summary = "cluster loop with no deadline or lease-expiry check"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_cluster_fn(node):
+            self._check_loops(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_cluster_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "cluster_loop_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_loops(self, fn: ast.AST) -> None:
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.While):
+                continue
+            nodes: List[ast.AST] = list(_walk_no_defs(node.test))
+            for stmt in node.body:
+                nodes.extend(_walk_no_defs(stmt))
+            if not self._consults_deadline(nodes):
+                self.report(
+                    node,
+                    "retry/heartbeat loop in cluster control-plane "
+                    f"function {fn.name}()"  # type: ignore[attr-defined]
+                    " never consults a deadline or lease expiry; a "
+                    "peer that stays silent forever would spin this "
+                    "loop forever — bound it (monotonic deadline, "
+                    "lease.expired()/remaining(), or a timed .wait())",
+                )
+
+    @staticmethod
+    def _consults_deadline(nodes: List[ast.AST]) -> bool:
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                seg = last_segment(n.func)
+                if seg in _CLOCK_CALLS or seg in _LEASE_CALLS:
+                    return True
+                if (
+                    seg == "wait"
+                    and isinstance(n.func, ast.Attribute)
+                    and (
+                        n.args
+                        or any(
+                            (kw.arg or "").startswith("timeout")
+                            for kw in n.keywords
+                        )
+                    )
+                ):
+                    return True  # timed stop-event wait bounds the spin
+                if any(
+                    (kw.arg or "").startswith("timeout")
+                    for kw in n.keywords
+                ):
+                    return True  # any bounded blocking call
+            elif isinstance(n, (ast.Name, ast.Attribute)):
+                seg = (last_segment(n) or "").lower()
+                if any(part in seg for part in _DEADLINE_NAME_PARTS):
+                    return True
+        return False

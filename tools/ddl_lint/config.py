@@ -42,6 +42,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL015",  # materialize-then-copy into the producer window view
     "DDL016",  # host round-trip in a device-distribution hot path
     "DDL017",  # train-step jax.jit without donate_argnums/donate_argnames
+    "DDL018",  # cluster loop with no deadline or lease-expiry check
 )
 
 
@@ -112,6 +113,19 @@ class LintConfig:
         default_factory=lambda: [
             "make_train_step",
             "make_multistep",
+        ]
+    )
+    #: Cluster control-plane functions (bare name or ``Class.method``):
+    #: every ``while`` loop inside them must consult a deadline or
+    #: lease expiry (DDL018) — an unbounded heartbeat/retry spin on a
+    #: silent peer is exactly the hang the control plane exists to kill.
+    cluster_loop_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "ClusterSupervisor.run",
+            "ClusterSupervisor._run",
+            "ClusterSupervisor.wait_for_epoch",
+            "probe_link_costs",
+            "measure_assignment",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -279,6 +293,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.train_step_functions = str_list(
         "train_step_functions", cfg.train_step_functions
+    )
+    cfg.cluster_loop_functions = str_list(
+        "cluster_loop_functions", cfg.cluster_loop_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
