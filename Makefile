@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench multihost cluster-test check chaos
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -60,6 +60,17 @@ opt-dryrun:
 placement-bench:
 	DDL_BENCH_MODE=placement JAX_PLATFORMS=cpu $(PY) bench.py
 
+# Multi-tenant ingest-service A/B (K concurrent tenants over the shared
+# fair-share scheduler, autoscaled vs static pool; docs/SERVING.md) +
+# the tenant-burst/host-loss chaos leg.
+tenancy-bench:
+	DDL_BENCH_MODE=tenancy JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Serve control-plane suite alone (admission/fair-share/autoscaler units,
+# concurrent-consumer fairness, the serve fault-site chaos rows).
+serve-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py -q
+
 # The full multi-process jax.distributed matrix: virtual-mesh legs
 # (dp, dp×sp, pp×dp, dp×ep), checkpoint resume, packed-stream fit, and
 # the cross-host elastic chaos leg (slow legs included).
@@ -81,7 +92,7 @@ check: lint bench-smoke
 # corruption/backend-failure ladder (tests/test_cache.py) + the ICI
 # DMA-failure → xla-fallback rung (tests/test_ici.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py -q
 
 # Distributed-optimizer suite alone (parity matrix, collective units,
 # the 4B fits-only-with-zero1 accounting test).

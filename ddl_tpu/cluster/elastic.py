@@ -34,7 +34,7 @@ import logging
 from typing import Any, FrozenSet, Iterable, Optional
 
 from ddl_tpu.cluster.membership import ClusterSupervisor, ClusterView, HostInfo
-from ddl_tpu.exceptions import ShutdownRequested
+from ddl_tpu.exceptions import DDLError, ShutdownRequested
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.types import ShardAdoption
 
@@ -310,10 +310,49 @@ class ElasticCluster:
                     pass
         return new
 
+    def drain_host(self, host_id: int) -> HostInfo:
+        """Graceful scale-down (the autoscaler's release half,
+        ``ddl_tpu.serve``).  Unlike :meth:`kill_host`, the host's
+        workers are PARKED, not killed: the epoch-fenced view change
+        drops its rings from every consumer pool (in-flight acquires
+        revoked at the fence) and re-partitions its shard ranges onto
+        survivors, while its producers simply idle against their full
+        rings — warm standby, so a later :meth:`rejoin_host` serves
+        their already-committed windows immediately.  A park outlasting
+        the transport stall budget ends with the producer exiting on
+        its fill timeout; the view has already left it to the cluster
+        ladder (the watchdog skips lost ranks), and a rejoin then rides
+        the normal respawn path.  Returns the departed
+        :class:`HostInfo` — the autoscaler's standby-reserve entry.
+        Refuses to drain the last loader host (the never-empty floor).
+        """
+        view = self.supervisor.view
+        host = view.host(host_id)
+        if host is None:
+            raise KeyError(f"host {host_id} is not in the view")
+        survivors = [
+            h for h in view.hosts
+            if h.loader_ranks and h.host_id != host_id
+        ]
+        if not survivors:
+            raise DDLError(
+                f"refusing to drain host {host_id}: it carries the last "
+                "loader ranks in the view (never-empty floor)"
+            )
+        # graceful=True: the identical epoch-fenced change, counted as
+        # cluster.host_drains (not host_losses — the failure counter
+        # alerting keys on) and logged WARNING.  The exchange still
+        # suspends until rejoin: the drained host's producers are
+        # PARKED, so an exchange schedule naming them would stall every
+        # round exactly as a dead host's would.
+        self.supervisor.declare_host_loss(host_id, graceful=True)
+        return host
+
     def rejoin_host(self, host: HostInfo) -> ClusterView:
         """Re-admit a recovered host (the ladder's exit).  The listener
         ships the re-partitioned ranges with ``suspend_exchange=False``
         — shuffle degradation lasts exactly until this fence."""
         new = self.supervisor.rejoin(host)
-        self._attach_worker_sources()
+        if self.workers is not None:
+            self._attach_worker_sources()
         return new

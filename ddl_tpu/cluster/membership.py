@@ -447,12 +447,21 @@ class ClusterSupervisor:
             return None
         return self._change_view(frozenset(dead))
 
-    def declare_host_loss(self, host_id: int) -> ClusterView:
+    def declare_host_loss(
+        self, host_id: int, graceful: bool = False
+    ) -> ClusterView:
         """Operator/ladder declaration: the host is gone NOW (no lease
-        wait) — e.g. the scheduler reported the node preempted."""
-        return self._change_view(frozenset({host_id}))
+        wait) — e.g. the scheduler reported the node preempted.
+        ``graceful`` marks a planned departure (the autoscaler's
+        drain-then-release): the identical epoch-fenced view change
+        runs, but it counts as ``cluster.host_drains`` — not
+        ``cluster.host_losses``, the failure counter alerting keys on —
+        and logs at WARNING, not ERROR."""
+        return self._change_view(frozenset({host_id}), graceful=graceful)
 
-    def _change_view(self, dead: FrozenSet[int]) -> ClusterView:
+    def _change_view(
+        self, dead: FrozenSet[int], graceful: bool = False
+    ) -> ClusterView:
         with self._lock:
             old = self.view
             # Chaos site: a crash here exercises the supervisor's
@@ -470,13 +479,18 @@ class ClusterSupervisor:
             for hid in dead:
                 self.leases.release(hid)
         self.metrics.incr("cluster.view_changes")
-        self.metrics.incr("cluster.host_losses", len(dead))
+        self.metrics.incr(
+            "cluster.host_drains" if graceful else "cluster.host_losses",
+            len(dead),
+        )
         self.metrics.set_gauge("cluster.epoch", new.epoch)
         self.metrics.set_gauge("cluster.hosts", len(new.hosts))
-        logger.error(
-            "cluster: host(s) %s lost — view epoch %d -> %d, shard "
+        logger.log(
+            logging.WARNING if graceful else logging.ERROR,
+            "cluster: host(s) %s %s — view epoch %d -> %d, shard "
             "ranges re-partitioned over %d survivor(s)",
-            sorted(dead), old.epoch, new.epoch, len(new.hosts),
+            sorted(dead), "drained" if graceful else "lost",
+            old.epoch, new.epoch, len(new.hosts),
         )
         self._notify(old, new, dead)
         return new

@@ -154,6 +154,10 @@ class DistributedDataLoader:
         self._pool_generation = -1
         self._pending_pool: Any = None
         self._cluster = cluster
+        # Multi-tenant admission seam (ddl_tpu.serve): when bound, every
+        # window acquisition passes the fair-share gate before touching
+        # a ring, and charges its byte size after — see bind_admission.
+        self._admission: Any = None
         if output == "jax":
             from ddl_tpu.ingest import DeviceIngestor
 
@@ -753,6 +757,27 @@ class DistributedDataLoader:
             self._release_current()
             self._target = self._next_target(self._target)
 
+    def bind_admission(self, admission: Any) -> None:
+        """Attach a multi-tenant admission gate (``ddl_tpu.serve``).
+
+        ``admission`` speaks the two-method protocol of
+        :class:`~ddl_tpu.serve.tenancy.Tenant`: ``admit(timeout_s)``
+        blocks (deadline-bounded) until the fair-share scheduler grants
+        this tenant its next window — raising
+        :class:`~ddl_tpu.exceptions.StallTimeoutError` on a
+        non-blocking probe (``timeout_s <= 0``, the lookahead-deepening
+        path) exactly like a not-yet-committed window — and
+        ``note_served(nbytes)`` charges the acquired window's bytes
+        against the tenant's share and budgets.  The hook lives in
+        ``_acquire_verified``, the one choke point every window
+        acquisition (batch, stream, lookahead, replay) already passes
+        through, so tenancy cannot be bypassed by any iteration style —
+        the same bypass-proof property the pool seam's
+        :meth:`~ddl_tpu.cluster.pool.LoaderPool.next_member` rotation
+        rule has.  ``None`` unbinds.
+        """
+        self._admission = admission
+
     def _next_target(self, t: int, include: bool = False) -> int:
         """The next ACTIVE ring target cyclically after ``t`` (or ``t``
         itself when ``include`` and it is active) — all rotation goes
@@ -907,6 +932,22 @@ class DistributedDataLoader:
         stops deepening and the window re-verifies when it reaches the
         head."""
         ring = self.connection.rings[target]
+        if self._admission is not None:
+            # Fair-share admission first (ddl_tpu.serve): no ring wait
+            # may start before the tenant's turn is granted — otherwise
+            # a slot could be held hostage while the scheduler throttles
+            # the holder.  Non-blocking probes (timeout_s <= 0) raise
+            # StallTimeoutError when not grantable, which the lookahead
+            # deepening treats as "not committed yet".  The admission
+            # wait SPENDS FROM the same budget the ring acquire gets:
+            # one acquisition, one timeout_s — a throttled tenant must
+            # not silently double the documented stall budget.
+            t_admit = time.monotonic()
+            self._admission.admit(timeout_s)
+            if timeout_s > 0:
+                timeout_s = max(
+                    0.0, timeout_s - (time.monotonic() - t_admit)
+                )
         pool_managed = (
             self._cluster is not None
             or self._pool is not None
@@ -946,23 +987,29 @@ class DistributedDataLoader:
                     if self._target_revoked(target):
                         raise _TargetRevoked(target)
                     raise
-        if not self._integrity:
-            return slot
-        expect = self._expected_seq(target, ahead)
-        err = self._verify_slot(target, slot, expect)
-        if err is None:
-            return slot
-        if ahead or timeout_s <= 0:
-            # Deferred, NOT counted yet: held slots forbid out-of-FIFO
-            # quarantine, and a non-blocking deepening probe
-            # (timeout_s == 0) must not run a replay wait under a
-            # zero-second budget — either way the same corrupt window
-            # re-verifies when a BLOCKING head acquire reaches it, which
-            # is where it is counted once and replayed under the
-            # loader's real timeout.
-            raise _CorruptAhead(err)
-        self.metrics.incr("integrity.corrupt_windows")
-        return self._quarantine_and_replay(target, expect, err, timeout_s)
+        if self._integrity:
+            expect = self._expected_seq(target, ahead)
+            err = self._verify_slot(target, slot, expect)
+            if err is not None:
+                if ahead or timeout_s <= 0:
+                    # Deferred, NOT counted yet: held slots forbid
+                    # out-of-FIFO quarantine, and a non-blocking
+                    # deepening probe (timeout_s == 0) must not run a
+                    # replay wait under a zero-second budget — either
+                    # way the same corrupt window re-verifies when a
+                    # BLOCKING head acquire reaches it, which is where
+                    # it is counted once and replayed under the
+                    # loader's real timeout.
+                    raise _CorruptAhead(err)
+                self.metrics.incr("integrity.corrupt_windows")
+                slot = self._quarantine_and_replay(
+                    target, expect, err, timeout_s
+                )
+        if self._admission is not None:
+            # The charge-after half of the fair-share gate: the
+            # window's actual byte size is only known post-acquire.
+            self._admission.note_served(int(ring.slot_payload(slot)))
+        return slot
 
     def _quarantine_and_replay(
         self, target: int, seq: int, err: str, timeout_s: float
@@ -1102,6 +1149,18 @@ class DistributedDataLoader:
         position where it stopped (one window per epoch — Q7 semantics)."""
         if self._release_backlog:
             self._flush_release_backlog()
+        # Resume replay is bookkeeping, not service: the discarded
+        # windows are never delivered to the tenant, so they must not
+        # pass (or be charged at) the fair-share admission gate — a
+        # byte-budgeted tenant would otherwise spend ~history/budget
+        # wall time (and its counters) replaying windows it never sees.
+        admission, self._admission = self._admission, None
+        try:
+            self._fast_forward_unadmitted(n_windows)
+        finally:
+            self._admission = admission
+
+    def _fast_forward_unadmitted(self, n_windows: int) -> None:
         for _ in range(n_windows):
             if self._staged_orphans:
                 # Early-released staged window: already off the ring;

@@ -109,6 +109,24 @@ class HeartbeatDropped(DDLError):
     """
 
 
+class TenantBurst(DDLError):
+    """A tenant's demand spiked (the ``TENANT_BURST`` fault kind at
+    ``serve.admit``, or a real admission adapter reporting a thundering
+    herd this way).
+
+    Carries ``burst_bytes`` — the phantom demand to charge.  The
+    fair-share scheduler (:mod:`ddl_tpu.serve.tenancy`) absorbs it by
+    charging the BURSTING tenant's own deficit and byte bucket: the
+    spike is paid for out of the burster's share, so its neighbours'
+    service rates are untouched (the isolation property the tenancy
+    chaos leg asserts).
+    """
+
+    def __init__(self, message: str = "", burst_bytes: float = 0.0):
+        self.burst_bytes = float(burst_bytes)
+        super().__init__(message)
+
+
 class InjectedFault(DDLError):
     """A deliberate failure raised by the fault-injection engine.
 

@@ -67,6 +67,18 @@ Injection points shipped today (site — fault kinds that act there):
                           crash/spurious-shutdown here exercises the
                           supervisor's own sweep-crash discrimination
                           (the watchdog.sweep contract, host-level)
+``serve.admit``           multi-tenant admission gate, once per
+                          admission attempt (``producer_idx`` carries
+                          the TENANT registration index):
+                          ``TENANT_BURST`` raises the real
+                          ``TenantBurst`` type with ``param`` phantom
+                          bytes — the fair-share scheduler charges them
+                          to the bursting tenant's own share, so the
+                          spike never starves its neighbours
+``serve.scale``           top of every ``Autoscaler.step``:
+                          ``SCALE_DECISION_DELAY`` sleeps ``param``
+                          seconds there — a slow control plane degrades
+                          scale-up reaction time, never correctness
 ========================  ====================================================
 """
 
@@ -87,6 +99,7 @@ from ddl_tpu.exceptions import (
     HostLostError,
     InjectedFault,
     ShutdownRequested,
+    TenantBurst,
 )
 
 
@@ -107,6 +120,8 @@ class FaultKind(enum.Enum):
     ICI_DMA_FAIL = "ici_dma_fail"
     HOST_LOSS = "host_loss"
     HEARTBEAT_DROP = "heartbeat_drop"
+    TENANT_BURST = "tenant_burst"
+    SCALE_DECISION_DELAY = "scale_decision_delay"
 
 
 @dataclasses.dataclass
@@ -241,6 +256,7 @@ class FaultPlan:
         elif kind in (
             FaultKind.PRODUCER_SLOWDOWN,
             FaultKind.STAGED_TRANSFER_TIMEOUT,
+            FaultKind.SCALE_DECISION_DELAY,
         ):
             time.sleep(spec.param or 0.2)
         elif kind in (
@@ -276,6 +292,15 @@ class FaultPlan:
             # Also the real type: the sweep counts the drop and lets the
             # lease age — a single lost beat must NEVER change the view.
             raise HeartbeatDropped(f"heartbeat dropped {where}")
+        elif kind is FaultKind.TENANT_BURST:
+            # The real type (the BACKEND_FETCH_FAIL pattern): the
+            # fair-share scheduler must absorb the spike exactly as it
+            # would a live thundering herd — phantom bytes charged to
+            # the bursting tenant's own share, neighbours untouched.
+            raise TenantBurst(
+                f"tenant burst {where}",
+                burst_bytes=spec.param or (64 << 20),
+            )
         elif kind is FaultKind.SHUFFLE_PEER_LOSS:
             raise DDLError(f"shuffle peer loss {where}")
         else:  # pragma: no cover - FaultKind is closed above

@@ -542,6 +542,28 @@ def north_star_report(
     report["shard_adoptions"] = m.counter("producer.shard_adoptions")
     report["cluster_cache_adoptions"] = m.counter("cluster.cache_adoptions")
     report["pool_updates"] = m.counter("consumer.pool_updates")
+    # Multi-tenant ingest service (ddl_tpu.serve, ISSUE 11): how many
+    # tenants share the fabric, how the autoscaler moved the pool
+    # (scale-ups via rejoin_host, scale-downs via drain-then-release),
+    # total time tenants spent parked at the fair-share admission gate,
+    # and each tenant's admission-stall fraction (the serve.stall.<t>
+    # gauges AdmissionController.report refreshes) — a "fair" run whose
+    # smallest tenant quietly waited out every round must be visible in
+    # the BENCH_* trajectories, exactly like replays and view changes.
+    report["serve_tenants"] = m.gauge("serve.tenants")
+    report["serve_scale_ups"] = m.counter("serve.scale_ups")
+    report["serve_scale_downs"] = m.counter("serve.scale_downs")
+    report["serve_admission_waits_s"] = m.timer(
+        "serve.admission_wait"
+    ).total_s
+    # Keyed by TENANT NAME only: set_gauge's ``.max`` high-water
+    # companions are dropped, or a consumer iterating the dict would
+    # see a phantom tenant "<name>.max".
+    report["serve_tenant_stall"] = {
+        k: v
+        for k, v in m.prefixed("serve.stall.").items()
+        if not k.endswith(".max")
+    }
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

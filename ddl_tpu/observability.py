@@ -35,7 +35,16 @@ heartbeats_dropped/shard_adoptions/cache_adoptions`` counters, the
 ``cluster.epoch``/``cluster.hosts`` gauges, plus the consumer-side
 pool seam's ``consumer.pool_updates`` counter / ``consumer.pool_size``
 gauge and the producer-side ``producer.shard_adoptions`` /
-``shuffle.suspensions/resumes/suspended_rounds`` ladder counters).
+``shuffle.suspensions/resumes/suspended_rounds`` ladder counters), and
+``serve.*`` (the multi-tenant ingest service, ``ddl_tpu.serve`` —
+``serve.admissions/rounds/tenant_bursts/scale_ups/scale_downs/replans``
+counters, the ``serve.admission_wait`` / ``serve.scale_up_reaction``
+timers, the ``serve.tenants`` / ``serve.pool_hosts`` /
+``serve.standby_hosts`` gauges, plus the per-tenant
+``serve.stall.<tenant>`` admission-stall gauges; each tenant's own
+traffic rides ``ingest.<tenant>.*`` — ``bytes``/``windows``/``bursts``
+counters and the ``admission_wait`` timer — read back per tenant with
+:meth:`Metrics.prefixed`).
 """
 
 from __future__ import annotations

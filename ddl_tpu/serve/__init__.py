@@ -1,0 +1,36 @@
+"""ddl_tpu.serve — multi-tenant ingest service over the elastic cluster.
+
+The service control plane that turns ``ddl_tpu.cluster``'s mechanism
+(resizable loader pool, epoch-fenced views, ``rejoin_host``) into a
+shared, demand-scaled ingest fabric (docs/SERVING.md):
+
+- **tenancy** — N independent loader jobs register as tenants against
+  one producer pool and one shard-cache tier; a deficit-round-robin
+  fair-share scheduler with per-tenant byte/slot budgets arbitrates
+  every window acquisition at the ring-acquire seam
+  (:class:`AdmissionController`, :class:`FairShareScheduler`,
+  :class:`TenantSpec`).
+- **autoscaler** — a DDL018-compliant policy loop reading the stall-
+  fraction / queue-depth demand signals, scaling the loader pool up
+  (``rejoin_host`` of standby hosts) and down (drain-then-release)
+  with hysteresis, cooldown, and a never-empty floor — re-running
+  ``plan_placement`` on every resize (:class:`Autoscaler`,
+  :class:`AutoscalerPolicy`).
+"""
+
+from ddl_tpu.serve.autoscaler import Autoscaler, AutoscalerPolicy
+from ddl_tpu.serve.tenancy import (
+    AdmissionController,
+    FairShareScheduler,
+    Tenant,
+    TenantSpec,
+)
+
+__all__ = [
+    "AdmissionController",
+    "Autoscaler",
+    "AutoscalerPolicy",
+    "FairShareScheduler",
+    "Tenant",
+    "TenantSpec",
+]

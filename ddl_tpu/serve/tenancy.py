@@ -1,0 +1,405 @@
+"""Multi-tenant admission: fair-share scheduling at the ring-acquire seam.
+
+The "millions of users" north star is many jobs hammering one shared
+ingest fabric, not one big job (ROADMAP item 1; MPMD disaggregation,
+arXiv:2412.14374).  PR 9 made the loader tier a resizable pool; this
+module makes it a *shared* one: N independent
+:class:`~ddl_tpu.dataloader.DistributedDataLoader` jobs register as
+**tenants** against one producer pool and one shard-cache tier, and a
+deficit-round-robin (DRR) fair-share scheduler arbitrates every window
+acquisition at the ring-acquire seam — the single bypass-proof gate the
+pool seam already owns (``LoaderPool.next_member`` rotation feeds
+``DistributedDataLoader._acquire_verified``, which is where the
+admission hook fires).
+
+Mechanics (docs/SERVING.md has the operator view):
+
+- **Charge-after DRR.**  ``admit()`` blocks until the tenant is
+  *grantable*; the actual byte charge lands at ``note_served(nbytes)``
+  (window size is only known post-acquire).  A tenant may therefore
+  overshoot its fair share by at most ONE window — the standard DRR
+  burst bound — and is then held until a replenish round restores its
+  deficit.  Rounds advance only when no waiting tenant is grantable, so
+  a backlogged tenant is never starved: per round every tenant earns
+  ``quantum_bytes * weight`` of credit (capped at one round's worth —
+  idle tenants cannot bank unbounded credit).
+- **Byte budget.**  ``byte_budget_per_s`` is a token bucket (charged at
+  ``note_served``, refilled by wall clock): a tenant over its rate
+  budget waits for refill even when the DRR would grant it.
+- **Slot budget.**  ``slot_budget`` caps the windows a tenant may be
+  granted per DRR round — a concurrency brake on top of the byte share.
+- **Bounded waits.**  ``admit`` is deadline-bounded and wakes on a timed
+  condition wait (DDL018/DDL019 discipline): a wedged peer can age a
+  tenant's wait into :class:`~ddl_tpu.exceptions.StallTimeoutError`,
+  never into a silent spin.
+
+Per-tenant observability rides the ``ingest.<tenant>.*`` name family
+(``bytes``/``windows``/``bursts`` counters, the ``admission_wait``
+timer) and is read back with :meth:`Metrics.prefixed` — see
+:meth:`AdmissionController.report`.  Aggregates live under ``serve.*``
+(``serve.admissions``, ``serve.tenant_bursts``, ``serve.rounds``, the
+``serve.admission_wait`` timer, the ``serve.tenants`` gauge).
+
+Chaos: the ``serve.admit`` fault site fires once per admission attempt
+(``producer_idx`` carries the tenant's registration index); the
+``TENANT_BURST`` kind raises the REAL :class:`~ddl_tpu.exceptions.
+TenantBurst` type, which the scheduler absorbs as phantom demand —
+``param`` bytes charged to the bursting tenant, so the burst is paid
+for by the burster's own share, never by its neighbours.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ddl_tpu.exceptions import DDLError, StallTimeoutError, TenantBurst
+from ddl_tpu.faults import fault_point
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+logger = logging.getLogger("ddl_tpu")
+
+#: Default DRR quantum: credit earned per tenant per replenish round,
+#: scaled by the tenant's weight.  Sized at a typical bench window so
+#: one round buys one window for a weight-1.0 tenant.
+DEFAULT_QUANTUM_BYTES = 4 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's admission contract.
+
+    ``weight`` scales the DRR quantum (2.0 = twice the fair share);
+    ``byte_budget_per_s`` caps sustained throughput (0 = uncapped);
+    ``slot_budget`` caps windows granted per DRR round (0 = uncapped).
+    """
+
+    name: str
+    weight: float = 1.0
+    byte_budget_per_s: float = 0.0
+    slot_budget: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.name or "." in self.name:
+            # The name becomes a metrics key segment (ingest.<name>.*):
+            # a dot would alias into another family's namespace.
+            raise DDLError(f"invalid tenant name {self.name!r}")
+        if self.weight <= 0:
+            raise DDLError(f"tenant weight must be > 0, got {self.weight}")
+        if self.byte_budget_per_s < 0 or self.slot_budget < 0:
+            raise DDLError("tenant budgets must be >= 0")
+
+
+class _TenantState:
+    """Scheduler-internal per-tenant accounting (guarded by the
+    scheduler's condition lock)."""
+
+    def __init__(self, spec: TenantSpec, index: int, now: float):
+        self.spec = spec
+        self.index = index
+        self.deficit = 0.0
+        # Token bucket: starts one second full so a fresh tenant's first
+        # window is never budget-blocked; refilled lazily from `stamp`.
+        self.tokens = float(spec.byte_budget_per_s)
+        self.stamp = now
+        self.served_in_round = 0
+        self.waiting = 0
+
+    def refill(self, now: float) -> None:
+        rate = self.spec.byte_budget_per_s
+        if rate <= 0:
+            return
+        self.tokens = min(
+            rate, self.tokens + rate * max(0.0, now - self.stamp)
+        )
+        self.stamp = now
+
+
+class FairShareScheduler:
+    """Deficit-round-robin arbiter over registered tenants.
+
+    Thread-safe: every tenant's consumer thread calls :meth:`admit` /
+    :meth:`note_served` concurrently; all state lives under one
+    condition lock.  The scheduler never touches rings — it only decides
+    *when* a tenant's next ring acquire may proceed.
+    """
+
+    def __init__(
+        self,
+        quantum_bytes: int = DEFAULT_QUANTUM_BYTES,
+        metrics: Optional[Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if quantum_bytes <= 0:
+            raise DDLError(f"quantum_bytes must be > 0, got {quantum_bytes}")
+        self.quantum_bytes = float(quantum_bytes)
+        self.metrics = metrics or default_metrics()
+        self._clock = clock
+        self._cond = threading.Condition()
+        # name -> state: bounded by the registered tenant set
+        # (register/unregister are the only growth/shrink sites).
+        self._tenants: Dict[str, _TenantState] = {}  # ddl-lint: disable=DDL013
+        self._next_index = 0
+        self._round = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, spec: TenantSpec) -> None:
+        with self._cond:
+            if spec.name in self._tenants:
+                raise DDLError(f"tenant {spec.name!r} is already registered")
+            self._tenants[spec.name] = _TenantState(
+                spec, self._next_index, self._clock()
+            )
+            self._next_index += 1
+            self.metrics.set_gauge("serve.tenants", len(self._tenants))
+            self._cond.notify_all()
+
+    def unregister(self, name: str) -> None:
+        with self._cond:
+            if self._tenants.pop(name, None) is not None:
+                self.metrics.set_gauge("serve.tenants", len(self._tenants))
+                # A departing tenant may have been the only non-grantable
+                # waiter blocking a round advance — wake the others.
+                self._cond.notify_all()
+
+    def tenants(self) -> "list[str]":
+        with self._cond:
+            return sorted(self._tenants)
+
+    # -- the admission gate ------------------------------------------------
+
+    def admit(self, name: str, timeout_s: float) -> None:
+        """Block until ``name`` is grantable (deadline-bounded).
+
+        ``timeout_s <= 0`` is the NON-BLOCKING probe the loader's
+        lookahead deepening uses: not-grantable raises
+        :class:`StallTimeoutError` immediately (the deepening loop
+        treats it exactly like a not-yet-committed window).
+        """
+        st = self._state(name)
+        try:
+            # Chaos site (producer_idx = tenant registration index).
+            fault_point("serve.admit", producer_idx=st.index)
+        except TenantBurst as burst:
+            self._charge_burst(name, st, burst.burst_bytes)
+        t0 = time.perf_counter()
+        deadline = self._clock() + max(0.0, timeout_s)
+        with self._cond:
+            st.waiting += 1
+            try:
+                while True:
+                    st.refill(self._clock())
+                    if self._grantable(st):
+                        break
+                    if self._advance_round_if_stuck():
+                        # Rounds replenish instantly (they are logical,
+                        # not wall-clock): re-check without sleeping —
+                        # a multi-quantum window costs loop passes, not
+                        # 50 ms apiece.  Terminates because each round
+                        # adds >= quantum * weight credit and rounds
+                        # only advance while NO waiter is grantable.
+                        continue
+                    now = self._clock()
+                    if now >= deadline:
+                        raise StallTimeoutError(
+                            f"tenant {name!r} admission not granted "
+                            f"within {timeout_s}s (deficit "
+                            f"{st.deficit:.0f}, tokens {st.tokens:.0f}, "
+                            f"round slots {st.served_in_round})"
+                        )
+                    self._cond.wait(min(0.05, deadline - now))
+            finally:
+                st.waiting -= 1
+        wait = time.perf_counter() - t0
+        self.metrics.incr("serve.admissions")
+        self.metrics.add_time("serve.admission_wait", wait)
+        self.metrics.add_time(f"ingest.{name}.admission_wait", wait)
+
+    def note_served(self, name: str, nbytes: int) -> None:
+        """Charge one served window against ``name``'s share + budgets
+        (the charge-after half of :meth:`admit`)."""
+        nbytes = int(nbytes)
+        with self._cond:
+            st = self._tenants.get(name)
+            if st is None:
+                return  # unregistered mid-flight: nothing left to charge
+            st.refill(self._clock())
+            st.deficit -= nbytes
+            if st.spec.byte_budget_per_s > 0:
+                st.tokens -= nbytes
+            st.served_in_round += 1
+            self._cond.notify_all()
+        self.metrics.incr(f"ingest.{name}.bytes", float(nbytes))
+        self.metrics.incr(f"ingest.{name}.windows")
+
+    # -- internals (condition lock held) -----------------------------------
+
+    def _state(self, name: str) -> _TenantState:
+        with self._cond:
+            st = self._tenants.get(name)
+            if st is None:
+                raise DDLError(f"tenant {name!r} is not registered")
+            return st
+
+    def _grantable(self, st: _TenantState) -> bool:
+        if st.spec.byte_budget_per_s > 0 and st.tokens < 0:
+            return False
+        if st.spec.slot_budget > 0 and (
+            st.served_in_round >= st.spec.slot_budget
+        ):
+            return False
+        return st.deficit >= 0
+
+    def _budget_blocked(self, st: _TenantState) -> bool:
+        """Blocked by the WALL-CLOCK token bucket (only time heals it —
+        a replenish round must not bypass the rate budget)."""
+        return st.spec.byte_budget_per_s > 0 and st.tokens < 0
+
+    def _advance_round_if_stuck(self) -> bool:
+        """One DRR replenish round, taken only when every waiting tenant
+        is blocked by deficit/slots (not by its wall-clock byte budget):
+        everyone earns ``quantum * weight`` credit — capped at one
+        round's worth — and the per-round slot counters reset.  Returns
+        True when a round advanced (the caller re-checks immediately)."""
+        waiters = [t for t in self._tenants.values() if t.waiting]
+        if not waiters:
+            return False
+        if any(self._grantable(t) for t in waiters):
+            return False  # someone can proceed; fairness says wait for them
+        if all(self._budget_blocked(t) for t in waiters):
+            return False  # only the clock may refill a rate budget
+        self._round += 1
+        for t in self._tenants.values():
+            credit = self.quantum_bytes * t.spec.weight
+            t.deficit = min(t.deficit + credit, credit)
+            t.served_in_round = 0
+        self.metrics.incr("serve.rounds")
+        self._cond.notify_all()
+        return True
+
+    def _charge_burst(
+        self, name: str, st: _TenantState, nbytes: float
+    ) -> None:
+        """Absorb an injected :class:`TenantBurst` as phantom demand:
+        the burst bytes are charged to the BURSTING tenant's deficit and
+        bucket, so its neighbours' shares are untouched and the burster
+        simply waits out its own spike."""
+        with self._cond:
+            st.refill(self._clock())
+            st.deficit -= nbytes
+            if st.spec.byte_budget_per_s > 0:
+                st.tokens -= nbytes
+        self.metrics.incr("serve.tenant_bursts")
+        self.metrics.incr(f"ingest.{name}.bursts")
+        logger.warning(
+            "serve: tenant %r absorbed an injected burst of %.0f bytes",
+            name, nbytes,
+        )
+
+
+class Tenant:
+    """One registered tenant's handle: the admission object a loader
+    binds (``loader.bind_admission(tenant)`` — or ``tenant.bind(loader)``)
+    so every ring acquire passes through the fair-share gate."""
+
+    def __init__(self, controller: "AdmissionController", spec: TenantSpec):
+        self.controller = controller
+        self.spec = spec
+        self.name = spec.name
+        self._closed = False
+
+    # The two-method admission protocol DistributedDataLoader speaks.
+
+    def admit(self, timeout_s: float) -> None:
+        self.controller.scheduler.admit(self.name, timeout_s)
+
+    def note_served(self, nbytes: int) -> None:
+        self.controller.scheduler.note_served(self.name, nbytes)
+
+    def bind(self, loader) -> "Tenant":
+        """Attach this tenant's admission gate to a loader (and hand it
+        the shared shard-cache tier's store for its producers via
+        ``controller.cache`` if the caller wires that themselves)."""
+        loader.bind_admission(self)
+        return self
+
+    def metrics(self) -> Dict[str, float]:
+        """This tenant's ``ingest.<name>.*`` family, prefix-stripped."""
+        return self.controller.metrics.prefixed(f"ingest.{self.name}.")
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.controller._release(self.name)
+
+
+class AdmissionController:
+    """The tenancy facade: one shared scheduler + one shared shard-cache
+    tier, fronted by :class:`Tenant` handles.
+
+    ``cache`` is the shared :class:`~ddl_tpu.cache.CacheStore` every
+    tenant's producers should be constructed over (``cache=`` kwarg on
+    the shard readers) — the controller does not inject it into
+    producers itself (producer functions cross spawn boundaries), it
+    just owns the single instance so N tenants share one warm tier.
+    """
+
+    def __init__(
+        self,
+        scheduler: Optional[FairShareScheduler] = None,
+        cache=None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.metrics = metrics or default_metrics()
+        self.scheduler = scheduler or FairShareScheduler(
+            metrics=self.metrics
+        )
+        self.cache = cache
+        # name -> Tenant handle; bounded by the registered tenant set.
+        self._handles: Dict[str, Tenant] = {}  # ddl-lint: disable=DDL013
+
+    def register(self, spec: TenantSpec) -> Tenant:
+        self.scheduler.register(spec)
+        handle = Tenant(self, spec)
+        self._handles[spec.name] = handle
+        return handle
+
+    def tenant(self, name: str) -> Tenant:
+        return self._handles[name]
+
+    def _release(self, name: str) -> None:
+        self.scheduler.unregister(name)
+        self._handles.pop(name, None)
+
+    def report(self) -> dict:
+        """Per-tenant ``ingest.<t>.*`` blocks plus the ``serve.*``
+        aggregates — the bench's ``tenancy.per_tenant`` body.  Also
+        refreshes the per-tenant ``serve.stall.<t>`` gauges (admission
+        wait over scheduler wall time) that ``north_star_report``
+        surfaces."""
+        m = self.metrics
+        elapsed = max(m.elapsed_s(), 1e-9)
+        per_tenant = {}
+        for name in self.scheduler.tenants():
+            block = m.prefixed(f"ingest.{name}.")
+            wait = m.timer(f"ingest.{name}.admission_wait")
+            block["admission_wait_s"] = wait.total_s
+            stall = wait.total_s / elapsed
+            m.set_gauge(f"serve.stall.{name}", stall)
+            block["stall_fraction"] = stall
+            per_tenant[name] = block
+        return {
+            "tenants": per_tenant,
+            "admissions": m.counter("serve.admissions"),
+            "rounds": m.counter("serve.rounds"),
+            "tenant_bursts": m.counter("serve.tenant_bursts"),
+            "admission_wait_s": m.timer("serve.admission_wait").total_s,
+        }
+
+    def close(self) -> None:
+        for name in list(self._handles):
+            self._handles[name].close()

@@ -443,6 +443,88 @@ class TestFaultMatrix:
         assert m.counter("watchdog.failures") == 0.0
         assert_full_coverage_byte_identical(seen)
 
+    def test_tenant_burst_mid_stream_byte_identical(self):
+        """TENANT_BURST at serve.admit (ISSUE 11): an injected demand
+        spike lands on a tenant's 4th admission mid-stream — the
+        fair-share scheduler absorbs it as phantom bytes charged to the
+        burster's own share (replenish rounds pay it down), and the
+        stream completes byte-identical with every window served (the
+        scheduler/runner live in ddl_tpu/serve + tests/test_serve.py;
+        the matrix row wires the kind into the tier-1 chaos sweep)."""
+        from test_serve import (
+            ROWS,
+            PatternProducer,
+            assert_pattern_windows,
+        )
+
+        from ddl_tpu import DistributedDataLoader, distributed_dataloader
+        from ddl_tpu.observability import Metrics
+        from ddl_tpu.serve import AdmissionController, TenantSpec
+
+        m = Metrics()
+        ctl = AdmissionController(metrics=m)
+        tenant = ctl.register(TenantSpec("burst-me"))
+        plan = FaultPlan(
+            [FaultSpec("serve.admit", FaultKind.TENANT_BURST,
+                       at=4, producer_idx=0, param=float(16 << 20))]
+        )
+        n_epochs = 8
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                PatternProducer(), batch_size=ROWS,
+                connection=env.connection, n_epochs=n_epochs,
+                output="numpy", timeout_s=30.0, metrics=m,
+            )
+            tenant.bind(loader)
+            wins = []
+            for _ in range(n_epochs):
+                for (win,) in loader:
+                    wins.append(win.copy())
+                    loader.mark(Marker.END_OF_BATCH)
+                loader.mark(Marker.END_OF_EPOCH)
+            return wins
+
+        with faults.armed(plan):
+            wins = main()
+        assert plan.fired and plan.fired[0][1] == "tenant_burst"
+        assert len(wins) == n_epochs
+        assert_pattern_windows(wins)
+        assert m.counter("serve.tenant_bursts") == 1.0
+        assert m.counter("ingest.burst-me.windows") == n_epochs
+        # The spike was paid down by replenish rounds, not a timeout.
+        assert m.counter("serve.rounds") >= 1.0
+
+    def test_scale_decision_delay_defers_but_preserves_the_decision(self):
+        """SCALE_DECISION_DELAY at serve.scale (ISSUE 11): the policy
+        loop's decision lands ``param`` seconds late and is the SAME
+        decision — reaction time degrades, membership correctness never
+        (the policy machine lives in ddl_tpu/serve/autoscaler.py; the
+        runner idiom mirrors tests/test_serve.py's)."""
+        from test_serve import FakeCluster, make_scaler
+
+        from ddl_tpu.cluster import HostInfo
+
+        clock = [0.1]
+        sig = {"stall_fraction": 0.9}
+        fc = FakeCluster([0])
+        sc = make_scaler(fc, sig, clock, sustain_s=0.0, cooldown_s=0.0,
+                         standby=[HostInfo(1, loader_ranks=(2,))])
+        plan = FaultPlan(
+            [FaultSpec("serve.scale", FaultKind.SCALE_DECISION_DELAY,
+                       at=1, param=0.1)]
+        )
+        t0 = time.perf_counter()
+        with faults.armed(plan):
+            out = sc.step()
+        assert time.perf_counter() - t0 >= 0.1
+        assert out == "up" and fc.rejoins == [1]
+        assert plan.fired[0][1] == "scale_decision_delay"
+        # The next (undelayed) step sees the grown pool and is a no-op
+        # within cooldown semantics — the delayed action was complete.
+        assert len(fc.supervisor.view.hosts) == 2
+
     def test_heartbeat_drop_expires_lease_then_recovers(self):
         """Persistent HEARTBEAT_DROP at cluster.heartbeat: single drops
         are absorbed (only the lease ages), but a host whose every beat

@@ -212,8 +212,37 @@ class TestNorthStarReport:
             "view_changes", "host_losses", "host_rejoins",
             "heartbeats_dropped", "shard_adoptions",
             "cluster_cache_adoptions", "pool_updates",
+            # multi-tenant ingest service extras (ISSUE 11:
+            # ddl_tpu.serve — admission + autoscaler)
+            "serve_tenants", "serve_scale_ups", "serve_scale_downs",
+            "serve_admission_waits_s", "serve_tenant_stall",
         }
         assert r["samples_per_sec"] > 0
+        # The per-tenant stall block is a DICT keyed by tenant name
+        # (empty when no tenancy ran), not a flat float.
+        assert isinstance(r["serve_tenant_stall"], dict)
+
+    def test_report_serve_block_reflects_tenancy(self):
+        """The serve_* keys chart real scheduler/autoscaler activity."""
+        from ddl_tpu.ingest import north_star_report
+        from ddl_tpu.observability import Metrics
+        from ddl_tpu.serve import AdmissionController, TenantSpec
+
+        m = Metrics()
+        m.incr("consumer.samples", 1)
+        ctl = AdmissionController(metrics=m)
+        a = ctl.register(TenantSpec("alpha"))
+        a.admit(1.0)
+        a.note_served(4096)
+        m.incr("serve.scale_ups")
+        ctl.report()  # refreshes the serve.stall.<tenant> gauges
+        r = north_star_report(m)
+        assert r["serve_tenants"] == 1
+        assert r["serve_scale_ups"] == 1
+        assert r["serve_admission_waits_s"] >= 0
+        # Keyed by tenant NAME only: set_gauge's ".max" companions are
+        # filtered, or consumers would see a phantom tenant "alpha.max".
+        assert set(r["serve_tenant_stall"]) == {"alpha"}
 
 
 class TestLoaderPrefetch:

@@ -193,6 +193,12 @@ VIOLATIONS = {
                     self.sweep()         # no deadline, no lease expiry
                     time.sleep(0.5)
     """,
+    "DDL019": """
+        class FairShareScheduler:
+            def admit(self, name, timeout_s):
+                for t in self._tenants.values():
+                    t.granted.wait(0.05)   # per-tenant wait fan-out
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -429,6 +435,25 @@ CLEAN = {
             while True:
                 sup.sweep()   # not a configured cluster loop
     """,
+    "DDL019": """
+        class FairShareScheduler:
+            def admit(self, name, timeout_s):
+                deadline = self._clock() + timeout_s
+                while True:
+                    states = []
+                    for t in self._tenants.values():
+                        states.append(t.snapshot())   # non-blocking body
+                    if self._grantable(name, states):
+                        break
+                    if self._clock() >= deadline:
+                        raise TimeoutError(name)
+                    self._cond.wait(0.05)   # ONE bounded wait per pass
+
+        class Autoscaler:
+            def _helper_outside_config(self):
+                for t in self._tenants:
+                    t.done.wait(1.0)   # not a configured serve loop
+    """,
 }
 
 
@@ -659,6 +684,55 @@ class TestSelfTest:
         findings = lint_snippet(tmp_path, "DDL018", src)
         assert [f.code for f in findings] == ["DDL018"]
         assert "wait_for_epoch" in findings[0].message
+
+    def test_ddl019_respects_configured_serve_loop_list(self, tmp_path):
+        """The fan-out ban is repo policy scoped to serve_loop_functions
+        — the same wait-in-a-for shape outside the config stays clean,
+        and even a TIMED per-tenant wait fires inside it (per-iteration
+        timeouts multiply by the tenant count)."""
+        src = """
+            class CustomGate:
+                def pump(self):
+                    for t in self._tenants:
+                        t.turn.wait(0.01)
+        """
+        cfg = LintConfig(serve_loop_functions=["OtherGate.pump"])
+        findings = lint_snippet(tmp_path, "DDL019", src, config=cfg)
+        assert findings == [], findings
+        cfg = LintConfig(serve_loop_functions=["CustomGate.pump"])
+        findings = lint_snippet(tmp_path, "DDL019", src, config=cfg)
+        assert [f.code for f in findings] == ["DDL019"]
+
+    def test_ddl019_sleep_and_join_fanouts_fire_while_dict_get_passes(
+        self, tmp_path
+    ):
+        """time.sleep / .join inside the tenant loop are the same
+        fan-out; dict .get() reads stay clean (snapshot-compute-act is
+        the sanctioned shape)."""
+        src = """
+            import time
+
+            class Autoscaler:
+                def step(self):
+                    for t in self._tenants:
+                        time.sleep(0.01)
+
+                def _run(self):
+                    for t in self._threads:
+                        t.join(1.0)
+        """
+        findings = lint_snippet(tmp_path, "DDL019", src)
+        assert sorted(f.code for f in findings) == ["DDL019", "DDL019"]
+        clean = """
+            class Autoscaler:
+                def step(self):
+                    for name in self._tenants:
+                        st = self._states.get(name)
+                        if st is not None:
+                            self._judge(st)
+        """
+        findings = lint_snippet(tmp_path, "DDL019", clean)
+        assert findings == [], findings
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

@@ -35,6 +35,14 @@ Four passes:
    the winner label must name the measured winner, and the membership
    counters must show the injected HOST_LOSS drove a real epoch-fenced
    view change (`view_changes`/`host_losses` >= 1).
+2d. `DDL_BENCH_MODE=tenancy` — the multi-tenant ingest-service A/B
+   block must carry its contract keys with >= 3 tenants, the autoscaled
+   pool's aggregate samples/s must be >= the static floor's
+   (`vs_static >= 1.0`, never-slower — retried once), every tenant's
+   stream byte-identical, a scale-up reaction time recorded, and the
+   chaos leg (injected TENANT_BURST + simultaneous HOST_LOSS) must show
+   both faults fired, every tenant byte-correct with full shard
+   coverage, and zero watchdog failures.
 3. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
    schedule/bubble gauges) and its `pipeline_overhead` against the
@@ -159,6 +167,36 @@ REQUIRED_PLACEMENT = (
 #: true win is ~4-8x, so 1.0 only catches a never-slower violation
 #: (one retry absorbs one-sided box noise).
 MIN_PLACEMENT_RATIO = 1.0
+#: The tenancy block's contract (ISSUE 11: DDL_BENCH_MODE=tenancy —
+#: the multi-tenant ingest-service A/B).  ``samples_per_sec`` must be
+#: the measured WINNER of the dynamic/static pair (never-headline-
+#: slower), ``vs_static`` must be >= MIN_TENANCY_VS_STATIC (the
+#: autoscaled pool may never lose to the static floor by more than
+#: noise — demand-driven growth only ever ADDS producer parallelism),
+#: every tenant's stream must be byte-identical, a scale-up reaction
+#: time must be recorded, and the chaos leg must show the injected
+#: tenant burst + host loss both fired with every tenant's stream
+#: byte-correct and zero watchdog failures.
+REQUIRED_TENANCY = (
+    "samples_per_sec", "dynamic_samples_per_sec",
+    "static_samples_per_sec", "vs_static", "winner", "n_tenants",
+    "demand_windows", "scale_ups", "scale_downs",
+    "scale_up_reaction_s", "per_tenant", "byte_identical",
+    "admission_wait_s", "chaos",
+)
+REQUIRED_TENANCY_CHAOS = (
+    "tenants", "byte_correct", "tenant_bursts", "host_losses",
+    "view_changes", "watchdog_failures", "fired_kinds",
+)
+REQUIRED_TENANT = (
+    "windows", "bytes", "p99_window_latency_s", "byte_identical",
+    "admission_wait_s",
+)
+#: Floor for the dynamic/static aggregate ratio (one retry absorbs
+#: one-sided box noise; the measured margin is ~1.1-2x).
+MIN_TENANCY_VS_STATIC = 1.0
+#: The ISSUE 11 acceptance floor on concurrent tenants.
+MIN_TENANTS = 3
 
 
 def _run_bench(mode: str) -> "dict | None":
@@ -519,6 +557,127 @@ def main() -> int:
             "did not drive the control plane"
         )
         return 1
+    # -- pass 2d: the multi-tenant ingest service (ISSUE 11) -----------
+    for attempt in range(1, 3):
+        tn_result = _run_bench("tenancy")
+        if tn_result is None:
+            return 1
+        tn = tn_result.get("tenancy")
+        if not isinstance(tn, dict):
+            print(json.dumps(tn_result, indent=1))
+            print(
+                "bench-smoke: no tenancy block "
+                f"(errors={tn_result.get('errors')})"
+            )
+            return 1
+        tn_missing = [k for k in REQUIRED_TENANCY if k not in tn]
+        chaos = tn.get("chaos")
+        if isinstance(chaos, dict):
+            tn_missing += [
+                f"chaos.{k}"
+                for k in REQUIRED_TENANCY_CHAOS
+                if k not in chaos
+            ]
+        for name, block in (tn.get("per_tenant") or {}).items():
+            tn_missing += [
+                f"per_tenant.{name}.{k}"
+                for k in REQUIRED_TENANT
+                if k not in block
+            ]
+        if tn_missing:
+            print(json.dumps(tn, indent=1))
+            print(f"bench-smoke: tenancy block missing keys: {tn_missing}")
+            return 1
+        if tn["n_tenants"] < MIN_TENANTS or len(tn["per_tenant"]) < MIN_TENANTS:
+            print(json.dumps(tn, indent=1))
+            print(
+                f"bench-smoke: tenancy ran {tn['n_tenants']} tenants "
+                f"(< {MIN_TENANTS}) — not a multi-tenant measurement"
+            )
+            return 1
+        tn_pair = {
+            "dynamic": tn["dynamic_samples_per_sec"],
+            "static": tn["static_samples_per_sec"],
+        }
+        tn_problems = []
+        if tn["samples_per_sec"] < max(tn_pair.values()):
+            tn_problems.append(
+                f"tenancy headline {tn['samples_per_sec']} is slower "
+                f"than a pool config the same run measured ({tn_pair}) "
+                "— never-slower invariant violated"
+            )
+        if tn["vs_static"] < MIN_TENANCY_VS_STATIC:
+            tn_problems.append(
+                f"dynamic/static aggregate ratio {tn['vs_static']} < "
+                f"{MIN_TENANCY_VS_STATIC} — the autoscaled pool lost "
+                "to the static floor"
+            )
+        if (
+            tn["winner"] not in tn_pair
+            or tn_pair[tn["winner"]] < max(tn_pair.values())
+            or tn_result.get("headline_config") != tn["winner"]
+        ):
+            tn_problems.append(
+                f"tenancy winner label {tn['winner']!r} / "
+                f"headline_config {tn_result.get('headline_config')!r} "
+                f"do not name the measured winner ({tn_pair})"
+            )
+        if not tn_problems:
+            break
+        if attempt < 2:
+            print(
+                f"bench-smoke: tenancy gates failed ({tn_problems}); "
+                "retrying once (one-sided box noise)"
+            )
+            continue
+        print(json.dumps(tn, indent=1))
+        for p in tn_problems:
+            print(f"bench-smoke: {p}")
+        return 1
+    # Deterministic tenancy assertions — never retried: byte identity,
+    # the recorded reaction time, and the chaos leg's counters.
+    if tn["byte_identical"] is not True or any(
+        b["byte_identical"] is not True for b in tn["per_tenant"].values()
+    ):
+        print(json.dumps(tn, indent=1))
+        print(
+            "bench-smoke: a tenant's stream was NOT byte-identical — "
+            "the fair-share gate changed data"
+        )
+        return 1
+    if tn["scale_ups"] < 1 or tn["scale_up_reaction_s"] is None:
+        print(json.dumps(tn, indent=1))
+        print(
+            "bench-smoke: dynamic leg recorded no scale-up "
+            f"(scale_ups={tn['scale_ups']}, "
+            f"reaction={tn['scale_up_reaction_s']}) — the autoscaler "
+            "never reacted to the demand burst"
+        )
+        return 1
+    tn_chaos = tn["chaos"]
+    if tn_chaos["byte_correct"] is not True:
+        print(json.dumps(tn, indent=1))
+        print(
+            "bench-smoke: tenancy chaos leg lost byte-correctness — a "
+            "tenant's stream was damaged by the burst + host loss"
+        )
+        return 1
+    if tn_chaos["tenant_bursts"] < 1 or tn_chaos["host_losses"] < 1:
+        print(json.dumps(tn, indent=1))
+        print(
+            "bench-smoke: tenancy chaos counters show the injected "
+            f"faults never fired (bursts={tn_chaos['tenant_bursts']}, "
+            f"host_losses={tn_chaos['host_losses']})"
+        )
+        return 1
+    if tn_chaos["watchdog_failures"] != 0:
+        print(json.dumps(tn, indent=1))
+        print(
+            "bench-smoke: tenancy chaos leg recorded "
+            f"{tn_chaos['watchdog_failures']} watchdog failure(s) — "
+            "recovery was misreported as failure"
+        )
+        return 1
     # -- pass 3: the training hot path (ISSUE 5) -----------------------
     overheads = []
     for attempt in range(1, FIT_ATTEMPTS + 1):
@@ -574,6 +733,10 @@ def main() -> int:
         f"int8 {opt['int8_loss_drift']}) state {opt['state_shrink']}x; "
         f"placement winner {pl['winner']} ratio {pl['ratio']} "
         f"(view_changes={pl['view_changes']}); "
+        f"tenancy winner {tn['winner']} vs_static {tn['vs_static']} "
+        f"({tn['n_tenants']} tenants, reaction "
+        f"{tn['scale_up_reaction_s']}s, chaos byte-correct, "
+        f"watchdog_failures={tn_chaos['watchdog_failures']}); "
         "fit_stream overhead "
         f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "
         f"(window_wait_s={fit['window_wait_s']})"

@@ -9,6 +9,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     jax_hazards,
     producer_fill,
     protocol,
+    serve_loops,
 )
 from tools.ddl_lint.checkers.base import REGISTRY, Checker, register
 

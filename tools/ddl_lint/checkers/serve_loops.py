@@ -1,0 +1,114 @@
+"""Serve control-plane loop invariants: bounded per-tenant work.
+
+The multi-tenant service layer (``ddl_tpu/serve``) runs scheduler and
+admission loops whose iteration space is the TENANT SET — a quantity
+that grows with load, unlike the fixed host/ring sets the cluster loops
+(DDL018) walk.  A blocking wait *inside* a per-tenant ``for`` loop
+multiplies its timeout by the tenant count: 1000 tenants × a 50 ms wait
+is a 50-second scheduler pass, and the admission gate IS the ingest hot
+path for every tenant behind it.  Repo rule (docs/LINT.md DDL019): a
+configured serve control-plane function may block at most once per
+PASS — never once per tenant.  ``for`` bodies must be non-blocking
+(snapshot state, compute, act); the single bounded wait lives outside
+the fan-out (the DDL018-style ``while`` + timed ``.wait()`` shape).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Blocking-call names banned inside a per-tenant ``for`` body.  Even a
+#: TIMED wait is a finding here: per-iteration timeouts sum over the
+#: tenant count, which is exactly the unbounded quantity.  (``.get()``
+#: is deliberately absent — ``dict.get`` is ubiquitous and harmless;
+#: blocking queue pops are DDL012's province.)
+_BLOCKING_CALLS = {"wait", "join", "sleep", "acquire", "admit"}
+
+
+def _walk_no_defs(root: ast.AST):
+    """Walk a subtree without descending into nested function/class
+    defs (a nested def's loops are checked when IT is configured)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+@register
+class ServeLoopFanout(Checker):
+    """DDL019: serve scheduler/admission loops must bound per-iteration
+    tenant work — no blocking-wait fan-out over the tenant set.
+
+    Functions named in ``[tool.ddl_lint] serve_loop_functions`` (bare
+    names or ``Class.method``) implement the admission/scheduling
+    machinery.  Inside one, a ``for`` (or ``async for``) body may not
+    call ``.wait()`` / ``.join()`` / ``.acquire()`` / ``.admit()`` /
+    ``time.sleep()`` — timed or not: per-iteration waits
+    multiply by the tenant count, and the tenant count is unbounded by
+    design.  Block once per pass, outside the fan-out (``while`` +
+    timed ``.wait()`` is the sanctioned DDL018 shape), and keep the
+    per-tenant body to snapshot-compute-act.
+
+    Escape hatch: ``# ddl-lint: disable=DDL019`` with a rationale.
+    """
+
+    code = "DDL019"
+    summary = "blocking wait inside a per-tenant serve loop"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_serve_fn(node):
+            self._check_loops(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_serve_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "serve_loop_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_loops(self, fn: ast.AST) -> None:
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            nodes: List[ast.AST] = []
+            for stmt in node.body + node.orelse:
+                nodes.extend(_walk_no_defs(stmt))
+            call = self._blocking_call(nodes)
+            if call is not None:
+                self.report(
+                    call,
+                    "blocking call inside a per-tenant loop of serve "
+                    f"control-plane function {fn.name}()"  # type: ignore[attr-defined]
+                    "; per-iteration waits multiply by the tenant "
+                    "count — snapshot state inside the fan-out and "
+                    "block at most once per pass, outside it (timed "
+                    ".wait() on the loop's own while, DDL018 shape)",
+                )
+
+    @staticmethod
+    def _blocking_call(nodes: List[ast.AST]):
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                seg = last_segment(n.func)
+                if seg in _BLOCKING_CALLS and isinstance(
+                    n.func, ast.Attribute
+                ):
+                    return n
+                if seg == "sleep":  # time.sleep / bare sleep
+                    return n
+        return None

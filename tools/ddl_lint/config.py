@@ -43,6 +43,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL016",  # host round-trip in a device-distribution hot path
     "DDL017",  # train-step jax.jit without donate_argnums/donate_argnames
     "DDL018",  # cluster loop with no deadline or lease-expiry check
+    "DDL019",  # blocking wait inside a per-tenant serve loop
 )
 
 
@@ -126,6 +127,20 @@ class LintConfig:
             "ClusterSupervisor.wait_for_epoch",
             "probe_link_costs",
             "measure_assignment",
+        ]
+    )
+    #: Serve control-plane functions (bare name or ``Class.method``):
+    #: scheduler/admission loops iterating the TENANT set.  A blocking
+    #: wait inside a per-tenant ``for`` body is DDL019 — per-iteration
+    #: timeouts multiply by the tenant count, which is unbounded by
+    #: design (block once per pass, outside the fan-out).
+    serve_loop_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "FairShareScheduler.admit",
+            "FairShareScheduler._advance_round_if_stuck",
+            "Autoscaler.step",
+            "Autoscaler._run",
+            "AdmissionController.report",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -296,6 +311,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.cluster_loop_functions = str_list(
         "cluster_loop_functions", cfg.cluster_loop_functions
+    )
+    cfg.serve_loop_functions = str_list(
+        "serve_loop_functions", cfg.serve_loop_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
