@@ -49,17 +49,12 @@ logger = logging.getLogger("ddl_tpu")
 
 def _transfer_ready(dev: Any) -> bool:
     """Non-blocking transfer-completion probe on a device value (a jax
-    array or tuple/pytree of them).  Arrays without ``is_ready`` (older
+    array or tuple/pytree of them).  Leaves without ``is_ready`` (older
     jax) report not-ready — the caller's forced flush still blocks
     correctly, the fast path just never triggers."""
-    try:
-        import jax
+    from ddl_tpu.utils import value_ready
 
-        return all(
-            bool(leaf.is_ready()) for leaf in jax.tree.leaves(dev)
-        )
-    except AttributeError:
-        return False
+    return value_ready(dev, default=False)
 
 
 class _CorruptAhead(Exception):
@@ -143,6 +138,11 @@ class DistributedDataLoader:
         # with forced (blocking) flushes only where the ring actually
         # needs the slot back.
         self._release_backlog: "list" = []
+        # Fused-step protocol seam: the most recently yielded stream
+        # window's backlog entry, so ``gate_release_on`` can re-gate its
+        # slot release on the CONSUMING step's done-future instead of
+        # the bare transfer (ddl_tpu.trainer._fused_stream_loop).
+        self._last_stream_entry: Any = None
         # Loader-pool decoupling seam (ddl_tpu.cluster): the APPLIED
         # LoaderPool this loader rotates over (members filtered to
         # local ring targets).  None = every ring (the static topology
@@ -596,6 +596,7 @@ class DistributedDataLoader:
             self.metrics.incr("ingest.windows")
             self.metrics.incr("consumer.windows")
             self.metrics.incr("consumer.samples", served)
+            self._last_stream_entry = None
             if not released:
                 if not isinstance(payload, StagedTransfer) and (
                     not self._ingestor.window_source_detached()
@@ -608,8 +609,15 @@ class DistributedDataLoader:
                     # (VERDICT r5 weak #4); release is instead deferred
                     # onto the transfer-completion probe
                     # (``_sweep_release_backlog``), forced only when the
-                    # ring runs out of slots.
-                    self._release_backlog.append([target, slot, dev])
+                    # ring runs out of slots.  The entry is remembered
+                    # so a fused-step consumer can re-gate it on the
+                    # consuming step's done-future (gate_release_on).
+                    # (Named distinctly from the enclosing ``entry``
+                    # parameter — the pending-queue 5-tuple — which the
+                    # staged-orphan branch below still reads.)
+                    backlog_entry = [target, slot, dev]
+                    self._release_backlog.append(backlog_entry)
+                    self._last_stream_entry = backlog_entry
                 else:
                     # Staged payload (copy+dispatch already awaited) or
                     # inline with a DETACHED source (the CPU client's
@@ -756,6 +764,45 @@ class DistributedDataLoader:
             self._batches_in_window = 0
             self._release_current()
             self._target = self._next_target(self._target)
+
+    def gate_release_on(self, done: Any) -> None:
+        """Fused-step protocol: gate the most recently yielded stream
+        window's deferred slot release on the CONSUMING step's
+        done-future, not the bare transfer.
+
+        ``done`` is any device value (or pytree of them) produced by
+        the step that consumed the window — e.g. the scanned
+        multistep's per-step losses.  The window's backlog entry grows
+        the future as an ADDITIONAL release condition: the
+        non-blocking sweep (``_sweep_release_backlog``) then frees the
+        slot only once both the transfer AND the consuming step have
+        completed, which is the two-slot ring discipline — the
+        producer may overwrite a slot only when the step that read its
+        window is done, so a re-fill can never race a still-running
+        scan's device reads (on clients that alias host pages the
+        transfer-done edge alone is not that guarantee).
+
+        No-op when the window's slot was already released at yield
+        (staged early release, or a detached CPU-client source): gating
+        is only ever an extra condition on an entry that exists, so a
+        consumer that never calls this keeps the plain transfer-probe
+        behavior, and the protocol cannot deadlock — the blocking flush
+        paths ``block_until_ready`` the combined future, and the step
+        completes independently of any slot.  One window at a time: the
+        gate applies to the LAST yielded window and is consumed by the
+        call (the fused trainer loop calls it once per step dispatch).
+        """
+        entry = self._last_stream_entry
+        self._last_stream_entry = None
+        if entry is None:
+            return
+        for e in self._release_backlog:
+            if e is entry:
+                # Tuple pytree: both the transfer value and the step
+                # future must probe ready before the sweep releases.
+                e[2] = (e[2], done)
+                self.metrics.incr("ingest.fused_gated")
+                return
 
     def bind_admission(self, admission: Any) -> None:
         """Attach a multi-tenant admission gate (``ddl_tpu.serve``).

@@ -507,6 +507,19 @@ def north_star_report(
     report["ici_fanout_s"] = m.timer("ici.fanout").total_s
     report["ici_redistribute_s"] = m.timer("ici.redistribute").total_s
     report["ici_peak_bytes"] = m.gauge("ici.peak_bytes")
+    # Fused compute/ingest step (ISSUE 12): how much of the data plane
+    # actually hid under the train step.  ``ingest_overlap_s`` is the
+    # trainer-measured lower bound on hidden ingest time (acquire spans
+    # that ran while the previous scan was still computing),
+    # ``fused_windows`` counts windows driven through the fused loop
+    # (``trainer.*``; the distributor's own two-slot dispatches ride
+    # ``ici.fused_windows`` inside the ici counters above), and
+    # ``slots_in_flight`` is the HIGH-WATER landing-slot occupancy —
+    # 2 means the double-buffer genuinely had both slots carrying
+    # unresolved windows at once.
+    report["ingest_overlap_s"] = m.timer("trainer.ingest_overlap").total_s
+    report["fused_windows"] = m.counter("trainer.fused_windows")
+    report["slots_in_flight"] = m.gauge("ici.slots_in_flight.max")
     # Distributed optimizer (ddl_tpu/parallel/optimizer.py, ISSUE 8):
     # optimizer-state bytes actually STORED per dp replica (shrinks ~dp×
     # under zero1), the per-step gradient-communication payload raw vs

@@ -11,14 +11,22 @@ JSON contract in ``tools/bench_smoke.py`` pins the load-bearing ones):
 ``consumer.*`` / ``ingest.*`` (drain + device feed — incl.
 ``ingest.release_wait``, forced transfer-completion waits before slot
 release), ``trainer.*`` (``trainer.window_wait`` — the stream loop's
-next-window waits, near zero when H2D overlaps the scans), ``pp.*``
+next-window waits, near zero when H2D overlaps the scans;
+``trainer.ingest_overlap`` — acquire time measurably hidden under a
+still-computing scan, the fused step's overlap proof; and the
+``trainer.fused_windows`` counter — windows driven through the fused
+compute/ingest loop, whose loader-side release gating rides
+``ingest.fused_gated``), ``pp.*``
 (``pp.bubble`` / ``pp.chunks`` gauges — the analytic bubble and chunk
 count of the last-compiled pipeline schedule), ``staging.*`` (the
 staged-ingest engine), ``watchdog.*`` / ``integrity.*`` / ``shuffle.*``
 (robustness events), ``ici.*`` (the device-side distribution tier —
 ``ici.bytes``/``ici.windows``/``ici.fallbacks`` counters, the
-``ici.fanout``/``ici.redistribute`` dispatch timers, and the
-``ici.peak_bytes`` gauge asserted by the redistribution planner),
+``ici.fanout``/``ici.redistribute`` dispatch timers, the
+``ici.peak_bytes`` gauge asserted by the redistribution planner, plus
+the fused two-slot protocol's ``ici.fused_windows`` counter and
+``ici.slots_in_flight`` landing-slot occupancy gauge — its ``.max``
+high-water is the report's ``slots_in_flight``),
 ``opt.*`` (the distributed optimizer —
 ``opt.state_bytes_per_replica``/``opt.state_bytes_total`` gauges set at
 init from the placed state, ``opt.grad_comm_bytes_raw``/

@@ -33,14 +33,30 @@ Kernel shape constraints (why the code looks the way it does):
   same reason: the forward of step ``t`` reads the half the recv of
   step ``t`` is not writing.
 
+- **Landing slots (fused step).**  The fused compute/ingest step keeps
+  TWO windows' fan-outs in flight: window N+1's ring is dispatched at
+  the entry of the step computing window N, and its DMA semaphores are
+  waited on only at the next step's first use of the data.  Two
+  concurrently-running collective kernels on a chip must not share
+  barrier semaphores, so every wrapper takes a ``slot`` (< ``N_SLOTS``)
+  selecting a *per-slot* Mosaic ``collective_id`` pair AND a per-slot
+  set of cached landing buffers — the device-side landing slots.  The
+  split start/wait surface is :func:`fanout_start` /
+  :func:`fanout_wait`: start IS the async dispatch of the slot's ring
+  program (the DMA ring is enqueued device-side and runs under the
+  in-flight step), and the wait is deferred to the consumer's first
+  use of the returned value (``sync=True`` forces a host
+  ``block_until_ready`` — the bring-up validation path only).
+
 The wrappers fall back to ``interpret=True`` off-TPU, which is how the
 CPU suite validates byte identity against the host path (tier-1); on a
 real pod the same kernels compile through Mosaic (``collective_id`` is
-reserved per mode).
+reserved per mode and slot).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Optional, Sequence, Tuple
 
@@ -64,10 +80,20 @@ AXIS = "x"
 #: floor for the window sizes the loader moves (>= 8 MiB).
 DEFAULT_CHUNKS = 4
 
+#: Device-side landing slots the fused step may keep in flight at once.
+#: Two is the double-buffer: window N+1's ring runs while window N's
+#: output is being consumed; a third slot would buy nothing (the step
+#: consuming window N-1 has already waited its data) and cost one more
+#: pinned landing-buffer set per geometry.
+N_SLOTS = 2
+
 #: Mosaic collective ids (must differ between concurrently-used
-#: collective kernels on a chip).
-_BCAST_COLLECTIVE_ID = 11
-_SCATTER_COLLECTIVE_ID = 12
+#: collective kernels on a chip).  Indexed by landing slot: the fused
+#: step keeps two ring programs in flight, and two kernels sharing a
+#: ``collective_id`` would share barrier semaphores — the per-slot pair
+#: is what makes the overlap sound on real hardware.
+_BCAST_COLLECTIVE_IDS = (11, 13)
+_SCATTER_COLLECTIVE_IDS = (12, 14)
 
 
 def _bcast_kernel(in_ref, out_ref, send_sem, recv_sem, copy_sem, *,
@@ -260,7 +286,8 @@ def _ring_mesh(devices: Tuple[Any, ...]):
 
 @functools.lru_cache(maxsize=64)
 def _bcast_call(devices: Tuple[Any, ...], rows: int, cols: int,
-                dtype_name: str, src: int, n_chunks: int, interpret: bool):
+                dtype_name: str, src: int, n_chunks: int, interpret: bool,
+                slot: int = 0):
     """Jitted shard_map'ed broadcast over ``devices``: input global
     (n * R_pad, cols) P(x) [only the source's block is real], output
     global (n * (R_pad + rows_per_chunk), cols) P(x) [payload + sink]."""
@@ -288,7 +315,7 @@ def _bcast_call(devices: Tuple[Any, ...], rows: int, cols: int,
         grid_spec=grid_spec,
         interpret=interpret,
         compiler_params=pltpu.TPUCompilerParams(
-            collective_id=_BCAST_COLLECTIVE_ID
+            collective_id=_BCAST_COLLECTIVE_IDS[slot]
         ),
     )
     fn = shard_map(
@@ -301,7 +328,8 @@ def _bcast_call(devices: Tuple[Any, ...], rows: int, cols: int,
 
 @functools.lru_cache(maxsize=64)
 def _scatter_call(devices: Tuple[Any, ...], rows: int, cols: int,
-                  dtype_name: str, src: int, interpret: bool):
+                  dtype_name: str, src: int, interpret: bool,
+                  slot: int = 0):
     """Jitted shard_map'ed scatter: input global (n * R, cols) P(x)
     [source block real], output global (R, cols) P(x) — row-block i on
     device i."""
@@ -332,7 +360,7 @@ def _scatter_call(devices: Tuple[Any, ...], rows: int, cols: int,
         grid_spec=grid_spec,
         interpret=interpret,
         compiler_params=pltpu.TPUCompilerParams(
-            collective_id=_SCATTER_COLLECTIVE_ID
+            collective_id=_SCATTER_COLLECTIVE_IDS[slot]
         ),
     )
     fn = shard_map(
@@ -343,17 +371,20 @@ def _scatter_call(devices: Tuple[Any, ...], rows: int, cols: int,
     return jax.jit(fn, in_shardings=spec, out_shardings=spec)
 
 
-@functools.lru_cache(maxsize=4)
+@functools.lru_cache(maxsize=8)
 def _landing_buffers(devices: Tuple[Any, ...], rows: int, cols: int,
-                     dtype_name: str, skip: int):
-    """Per-device landing buffers for the non-source ring slots (the
+                     dtype_name: str, skip: int, slot: int = 0):
+    """Per-device landing buffers for the non-source ring positions (the
     SPMD input needs a block on every device; only the source's carries
-    data).  Cached per geometry so steady-state windows allocate
-    nothing — each entry PINS one window-sized block per non-source
-    device in HBM for the cache's life, which is why (a) the cache is
-    small (a loader cycles a handful of window geometries, not 64) and
-    (b) the redistribution plan prices the landing block into its
-    asserted per-device peak."""
+    data).  Cached per (geometry, landing slot) so steady-state windows
+    allocate nothing — each entry PINS one window-sized block per
+    non-source device in HBM for the cache's life, which is why (a) the
+    cache is small (a loader cycles a handful of window geometries ×
+    ``N_SLOTS`` landing slots, not 64) and (b) the redistribution plan
+    prices the landing blocks — one set per IN-FLIGHT slot — into its
+    asserted per-device peak.  Keying by ``slot`` keeps two in-flight
+    ring programs off each other's input buffers, so XLA sees no shared
+    operand ordering the dispatches."""
     zeros = np.zeros((rows, cols), np.dtype(dtype_name))
     return tuple(
         None if i == skip else jax.device_put(zeros, d)
@@ -362,15 +393,15 @@ def _landing_buffers(devices: Tuple[Any, ...], rows: int, cols: int,
 
 
 def _as_ring_input(block: Any, devices: Tuple[Any, ...], rows: int,
-                   cols: int, src: int):
+                   cols: int, src: int, slot: int = 0):
     """Assemble the SPMD global input (n * rows, cols) P(x): the source
     block plus cached landing buffers — zero host traffic after the
-    first call per geometry."""
+    first call per (geometry, slot)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_dev = len(devices)
     dtype_name = np.dtype(block.dtype).name
-    landing = _landing_buffers(devices, rows, cols, dtype_name, src)
+    landing = _landing_buffers(devices, rows, cols, dtype_name, src, slot)
     shards = [landing[i] if i != src else block for i in range(n_dev)]
     return jax.make_array_from_single_device_arrays(
         (n_dev * rows, cols),
@@ -382,19 +413,34 @@ def _as_ring_input(block: Any, devices: Tuple[Any, ...], rows: int,
 # -- public wrappers ----------------------------------------------------------
 
 
+def _check_slot(slot: int) -> int:
+    slot = int(slot)
+    if not 0 <= slot < N_SLOTS:
+        raise ValueError(
+            f"landing slot must be in [0, {N_SLOTS}), got {slot}"
+        )
+    return slot
+
+
 def fanout_replicate(block: Any, devices: Sequence[Any], src: int = 0,
                      n_chunks: int = DEFAULT_CHUNKS,
-                     interpret: Optional[bool] = None) -> Any:
+                     interpret: Optional[bool] = None,
+                     slot: int = 0) -> Any:
     """Broadcast a (rows, cols) device block to every ring device.
 
     ``block`` must live on ``devices[src]``.  Returns a global
     ``(n * rows, cols)`` array sharded one block per device, every block
     byte-identical to the source (callers reinterpret the shards — see
     :func:`replicated_view`).  Rows are padded up to a chunk multiple
-    internally and sliced back off.
+    internally and sliced back off.  ``slot`` selects the landing slot
+    (collective-id pair + cached landing buffers); callers keeping two
+    fan-outs in flight must alternate slots.
     """
     devices = tuple(devices)
     n_dev = len(devices)
+    # Validate BEFORE the single-device passthrough: a bad slot must
+    # fail on the 1-device dev box, not first on a real ring.
+    slot = _check_slot(slot)
     if n_dev == 1:
         return block
     if interpret is None:
@@ -405,10 +451,10 @@ def fanout_replicate(block: Any, devices: Sequence[Any], src: int = 0,
     if pad:
         block = jnp.pad(block, ((0, pad), (0, 0)))
     rows_pad = rows + pad
-    gin = _as_ring_input(block, devices, rows_pad, cols, src)
+    gin = _as_ring_input(block, devices, rows_pad, cols, src, slot)
     call = _bcast_call(
         devices, rows_pad, cols, np.dtype(block.dtype).name, src,
-        n_chunks, interpret,
+        n_chunks, interpret, slot,
     )
     out = call(gin)  # (n * (rows_pad + chunk), cols): payload + sink
     return _strip_blocks(out, devices, rows_pad + rows_pad // n_chunks,
@@ -416,16 +462,18 @@ def fanout_replicate(block: Any, devices: Sequence[Any], src: int = 0,
 
 
 def fanout_shard(block: Any, devices: Sequence[Any], src: int = 0,
-                 interpret: Optional[bool] = None) -> Any:
+                 interpret: Optional[bool] = None, slot: int = 0) -> Any:
     """Scatter a (rows, cols) device block: row-block ``i`` lands on
     ``devices[(src + ((i - src) % n)) % n]`` — i.e. block i on device i.
 
     ``rows`` must divide evenly by the ring size (the planner guarantees
     this or falls back).  Returns a global (rows, cols) array sharded
-    P(x) over the ring.
+    P(x) over the ring.  ``slot`` selects the landing slot, as in
+    :func:`fanout_replicate`.
     """
     devices = tuple(devices)
     n_dev = len(devices)
+    slot = _check_slot(slot)  # before the passthrough, as in replicate
     if n_dev == 1:
         return block
     if interpret is None:
@@ -436,11 +484,72 @@ def fanout_shard(block: Any, devices: Sequence[Any], src: int = 0,
             f"shard fan-out needs rows ({rows}) divisible by the ring "
             f"size ({n_dev})"
         )
-    gin = _as_ring_input(block, devices, rows, cols, src)
+    gin = _as_ring_input(block, devices, rows, cols, src, slot)
     call = _scatter_call(
-        devices, rows, cols, np.dtype(block.dtype).name, src, interpret
+        devices, rows, cols, np.dtype(block.dtype).name, src, interpret,
+        slot,
     )
     return call(gin)
+
+
+@dataclasses.dataclass(frozen=True)
+class FanoutTicket:
+    """A started (dispatched, possibly still in flight) fan-out.
+
+    ``value`` is the kernel's output as an ASYNC device value: the ring
+    program is enqueued device-side at :func:`fanout_start` and its DMA
+    semaphores are waited on by the hardware, not the host — the host
+    thread returns immediately and the consuming step's first use of
+    ``value`` is the wait leg.  The ticket records which landing slot
+    the window occupies so callers can assert the double-buffer
+    discipline (at most one in-flight window per slot).
+    """
+
+    value: Any
+    mode: str  #: "replicate" | "shard"
+    slot: int
+
+
+def fanout_start(mode: str, block: Any, devices: Sequence[Any],
+                 src: int = 0, *, slot: int = 0,
+                 n_chunks: int = DEFAULT_CHUNKS,
+                 interpret: Optional[bool] = None) -> FanoutTicket:
+    """Start a fan-out into landing slot ``slot``; never waits.
+
+    The start half of the fused step's split start/wait surface: the
+    ring program for window N+1 is dispatched here — at the entry of
+    the step computing window N — and runs under that step.  Pair with
+    :func:`fanout_wait`.
+    """
+    slot = _check_slot(slot)  # fail BEFORE dispatching side effects
+    if mode == "replicate":
+        out = fanout_replicate(
+            block, devices, src=src, n_chunks=n_chunks,
+            interpret=interpret, slot=slot,
+        )
+    elif mode == "shard":
+        out = fanout_shard(
+            block, devices, src=src, interpret=interpret, slot=slot
+        )
+    else:
+        raise ValueError(f"mode must be replicate|shard, got {mode!r}")
+    return FanoutTicket(value=out, mode=mode, slot=slot)
+
+
+def fanout_wait(ticket: FanoutTicket, sync: bool = False) -> Any:
+    """The wait half: hand the started fan-out's value to its consumer.
+
+    The real wait is the DATA DEPENDENCE — the consuming step's first
+    use of the returned value drains the slot's DMA semaphores on
+    device, with the host never blocking.  ``sync=True`` forces a host
+    ``block_until_ready`` and is reserved for the bring-up validation
+    path (the first window of a geometry, where an async DMA failure
+    must surface inside the distributor's fallback ladder rather than
+    at the consumer's sync point).
+    """
+    if sync:
+        jax.block_until_ready(ticket.value)
+    return ticket.value
 
 
 def _strip_blocks(out: Any, devices: Tuple[Any, ...], block_rows: int,

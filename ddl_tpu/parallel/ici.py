@@ -40,6 +40,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -63,6 +64,21 @@ logger = logging.getLogger("ddl_tpu")
 DEFAULT_MEMORY_FACTOR = 3.0
 
 
+def fused_enabled(default: bool = True) -> bool:
+    """The ``DDL_TPU_FUSED`` escape hatch (default ON).
+
+    Gates both halves of the fused compute/ingest step: the
+    distributor's two-slot (double-buffered landing) dispatch here and
+    the trainer's fused stream loop (``Trainer._fused_stream_loop``).
+    ``DDL_TPU_FUSED=0`` restores the synchronous discipline everywhere
+    — the same path a latched DMA failure degrades to.
+    """
+    val = os.environ.get("DDL_TPU_FUSED")
+    if val is None:
+        return default
+    return val != "0"
+
+
 class PlanError(ValueError):
     """The target sharding has no bounded-memory ICI plan (caller falls
     back to the XLA path)."""
@@ -70,12 +86,23 @@ class PlanError(ValueError):
 
 @dataclasses.dataclass(frozen=True)
 class RedistLeg:
-    """One plan step: what moves, over which axes, at what cost."""
+    """One plan step: what moves, over which axes, at what cost.
+
+    ``asynchronous`` marks a leg emitted as a start/wait PAIR (the
+    fused two-slot protocol): its start is the async dispatch of the
+    slot's ring program and its wait is the consuming step's first use
+    of the data.  Async legs are REMAT-COMPATIBLE by construction —
+    they run outside the consuming step's trace, so a consumer wrapped
+    in ``jax.checkpoint`` recomputes its own activations from the
+    landed window (an input) without ever re-executing the DMA ring
+    (asserted by tests/test_ici.py's remat row).
+    """
 
     kind: str  #: "fanout.replicate" | "fanout.shard" | "all_gather" | "reshape"
     axes: Tuple[str, ...]  #: named mesh axes the leg communicates over
     ici_bytes: int  #: bytes this leg moves over ICI (wire, per window)
     peak_bytes: int  #: max per-device live bytes during the leg
+    asynchronous: bool = False  #: emitted as a start/wait pair (fused)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +122,7 @@ class DistributionPlan:
     peak_bytes: int  #: max per-device live bytes across legs (incl. landing)
     dst_shard_bytes: int  #: destination per-device shard size
     peak_factor: float  #: peak_bytes / window bytes (asserted bound)
+    n_slots: int = 1  #: landing slots priced in flight (2 = fused)
 
     @property
     def anchor(self):
@@ -141,15 +169,26 @@ def plan_distribution(
     shape: Sequence[int],
     dtype: Any,
     sharding: Any,
-    max_memory_factor: float = DEFAULT_MEMORY_FACTOR,
+    max_memory_factor: Optional[float] = None,
     n_chunks: Optional[int] = None,
+    n_slots: int = 1,
 ) -> DistributionPlan:
     """Plan the anchor→``sharding`` route for one window geometry.
 
+    ``n_slots`` prices the fused two-slot protocol: with 2 landing
+    slots, window N+1's fan-out is live (its landing buffers, output
+    and transit) while window N's finish legs run, so every leg's peak
+    carries one extra in-flight fan-out's worth of bytes and the
+    fan-out legs themselves are emitted ``asynchronous`` — start/wait
+    pairs whose wait is the consuming step's first use (and which
+    therefore survive a ``jax.checkpoint`` around that step).
+    ``max_memory_factor`` defaults to ``DEFAULT_MEMORY_FACTOR *
+    n_slots`` — the single-slot worst case per in-flight slot.
+
     Raises :class:`PlanError` when no bounded plan exists (unsupported
     spec shape, split dim not divisible by the device count, or the
-    computed peak exceeding ``max_memory_factor`` × the destination
-    shard) — callers fall back to the XLA path and count it.
+    computed peak exceeding ``max_memory_factor`` × the window) —
+    callers fall back to the XLA path and count it.
     """
     from ddl_tpu.ops import ici_fanout
 
@@ -164,6 +203,10 @@ def plan_distribution(
         a for a in mesh.axis_names if a not in split_axes
     )
     n_chunks = n_chunks or ici_fanout.DEFAULT_CHUNKS
+    n_slots = max(1, min(int(n_slots), ici_fanout.N_SLOTS))
+    if max_memory_factor is None:
+        max_memory_factor = DEFAULT_MEMORY_FACTOR * n_slots
+    fused = n_slots > 1
 
     if split_dim is None:
         ring = _ring_order(mesh, (), rest_axes)
@@ -179,11 +222,15 @@ def plan_distribution(
         # every ring device needs an equal-shaped input block) + the
         # kernel output (the full window, which IS the target, plus the
         # sink chunk riding along during the kernel).  Chunk = whole
-        # padded rows, matching the kernel's row padding.
+        # padded rows, matching the kernel's row padding.  Every
+        # ADDITIONAL in-flight landing slot pins one more landing +
+        # output set for its whole dispatch span.
         chunk = -(-rows // n_chunks) * (nbytes // rows)
-        peak = 2 * nbytes + chunk
+        slot_live = 2 * nbytes + chunk
+        peak = n_slots * slot_live
         legs = (
-            RedistLeg("fanout.replicate", ("x",), wire, peak),
+            RedistLeg("fanout.replicate", ("x",), wire, peak,
+                      asynchronous=fused),
         )
         dst = nbytes
         plan = DistributionPlan(
@@ -191,7 +238,7 @@ def plan_distribution(
             split_axes=(), rest_axes=rest_axes, ring_devices=ring,
             legs=legs, wire_bytes=wire, payload_bytes=payload,
             peak_bytes=peak, dst_shard_bytes=dst,
-            peak_factor=peak / nbytes,
+            peak_factor=peak / nbytes, n_slots=n_slots,
         )
     else:
         split = shape[split_dim]
@@ -206,11 +253,17 @@ def plan_distribution(
         payload = ici_fanout.payload_bytes("shard", nbytes, n_dev)
         block = nbytes // n_dev
         dst = nbytes // g
+        # Scatter slot-live: the window-sized SPMD landing block (cached
+        # on every ring device) + the output block + the kernel's
+        # double-buffered VMEM transit (2 blocks).  With the fused
+        # two-slot protocol the NEXT window's fan-out is live through
+        # every leg of this window's plan, so each leg carries one
+        # extra slot-live span.
+        slot_live = nbytes + 3 * block
+        extra = (n_slots - 1) * slot_live
         legs: List[RedistLeg] = [
-            # Scatter peak: the window-sized SPMD landing block (cached
-            # on every ring device) + the output block + the kernel's
-            # double-buffered VMEM transit (2 blocks).
-            RedistLeg("fanout.shard", ("x",), wire, nbytes + 3 * block),
+            RedistLeg("fanout.shard", ("x",), wire, slot_live + extra,
+                      asynchronous=fused),
         ]
         if rest_axes:
             m = n_dev // g
@@ -220,10 +273,10 @@ def plan_distribution(
             legs.append(
                 RedistLeg(
                     "all_gather", rest_axes, n_dev * (m - 1) * block,
-                    nbytes + block + dst,
+                    nbytes + block + dst + extra,
                 )
             )
-        legs.append(RedistLeg("reshape", (), 0, nbytes + dst))
+        legs.append(RedistLeg("reshape", (), 0, nbytes + dst + extra))
         peak = max(leg.peak_bytes for leg in legs)
         plan = DistributionPlan(
             mode="shard", shape=shape, dtype=dtype, split_dim=split_dim,
@@ -232,7 +285,7 @@ def plan_distribution(
                 legs[1].ici_bytes if rest_axes else 0
             ),
             payload_bytes=payload, peak_bytes=peak, dst_shard_bytes=dst,
-            peak_factor=peak / nbytes,
+            peak_factor=peak / nbytes, n_slots=n_slots,
         )
     if plan.peak_factor > max_memory_factor:
         raise PlanError(
@@ -250,6 +303,17 @@ def plan_distribution(
 # with the other mesh-keyed compiled-call caches (importing it here is
 # free: ddl_tpu.parallel.__init__ already loads collectives eagerly).
 from ddl_tpu.parallel.collectives import _MeshKey  # noqa: E402
+
+
+def _value_ready(value: Any) -> bool:
+    """Non-blocking completion probe for the fused-step OBSERVABILITY
+    paths (slots-in-flight gauge, the trainer's overlap accounting):
+    one shared implementation (:func:`ddl_tpu.utils.value_ready`), with
+    the ready-by-default fallback — gauges degrade to zero rather than
+    the probe becoming a sync."""
+    from ddl_tpu.utils import value_ready
+
+    return value_ready(value, default=True)
 
 
 @functools.lru_cache(maxsize=64)
@@ -349,6 +413,20 @@ class IciDistributor:
       link failure on already-validated geometry still surfaces
       downstream — that rung is the trainer's existing failure path, not
       this latch.
+
+    **Fused two-slot dispatch** (default, ``DDL_TPU_FUSED=0`` off):
+    consecutive windows alternate between :data:`~ddl_tpu.ops.
+    ici_fanout.N_SLOTS` device-side landing slots — per-slot collective
+    ids and landing buffers — so window N+1's ring program is dispatched
+    (``fanout_start``) while window N's output is still being consumed,
+    and the DMA semaphores are waited on only at the consuming step's
+    first use of the data (``fanout_wait``'s data dependence).  The
+    ``ici.slots_in_flight`` gauge tracks how many slots actually carry
+    an unresolved window (high-water rides ``.max``); every fused
+    window also ticks ``ici.fused_windows``.  A latch clears the
+    in-flight tracking but never strands a started slot: already-
+    dispatched ring programs resolve on their own device-side
+    semaphores, independent of later windows taking the xla path.
     """
 
     def __init__(
@@ -356,15 +434,31 @@ class IciDistributor:
         sharding: Any,
         metrics: Optional[Metrics] = None,
         interpret: Optional[bool] = None,
-        max_memory_factor: float = DEFAULT_MEMORY_FACTOR,
+        max_memory_factor: Optional[float] = None,
         n_chunks: Optional[int] = None,
+        n_slots: Optional[int] = None,
     ):
+        from ddl_tpu.ops import ici_fanout
+
         self.sharding = sharding
         self.metrics = metrics or default_metrics()
         self.interpret = interpret
+        if n_slots is None:
+            n_slots = ici_fanout.N_SLOTS if fused_enabled() else 1
+        self.n_slots = max(1, min(int(n_slots), ici_fanout.N_SLOTS))
+        # The plan's memory bound scales with the in-flight slot count
+        # (each slot pins one landing + output set); an explicit factor
+        # wins.
+        if max_memory_factor is None:
+            max_memory_factor = DEFAULT_MEMORY_FACTOR * self.n_slots
         self.max_memory_factor = max_memory_factor
         self.n_chunks = n_chunks
         self.faulted = False
+        self._slot = 0  # next landing slot (cycled per fused window)
+        # Recent async outputs, tracked ONLY for the slots_in_flight
+        # gauge (bounded by n_slots; resolved entries are swept on the
+        # next dispatch).  Dropping an entry never cancels its window.
+        self._in_flight: "list" = []
         self._mesh_key = _MeshKey(sharding.mesh)
         # geometry -> DistributionPlan | PlanError; windows recur over a
         # handful of geometries, and a failed plan must not be re-derived
@@ -390,7 +484,7 @@ class IciDistributor:
                 hit = plan_distribution(
                     key[0], key[1], self.sharding,
                     max_memory_factor=self.max_memory_factor,
-                    n_chunks=self.n_chunks,
+                    n_chunks=self.n_chunks, n_slots=self.n_slots,
                 )
             except PlanError as e:
                 hit = e
@@ -461,19 +555,22 @@ class IciDistributor:
         fault_point("ici.fanout")
         m = self.metrics
         dtype_name = np.dtype(block.dtype).name
+        slot = self._slot
         t0 = time.perf_counter()
         if plan.mode == "replicate":
             flat = _to2d_call(
                 plan.anchor, plan.shape, dtype_name, 0
             )(block)
-            out = ici_fanout.fanout_replicate(
-                flat, plan.ring_devices, src=0,
+            ticket = ici_fanout.fanout_start(
+                "replicate", flat, plan.ring_devices, src=0, slot=slot,
                 n_chunks=self.n_chunks or ici_fanout.DEFAULT_CHUNKS,
                 interpret=self.interpret,
             )
             m.add_time("ici.fanout", time.perf_counter() - t0)
             t1 = time.perf_counter()
-            rep = ici_fanout.replicated_view(out, plan.ring_devices)
+            rep = ici_fanout.replicated_view(
+                ici_fanout.fanout_wait(ticket), plan.ring_devices
+            )
             result = _finish_replicate_call(
                 self._mesh_key, plan.shape, dtype_name
             )(rep)
@@ -482,15 +579,16 @@ class IciDistributor:
             flat = _to2d_call(
                 plan.anchor, plan.shape, dtype_name, plan.split_dim
             )(block)
-            out = ici_fanout.fanout_shard(
-                flat, plan.ring_devices, src=0, interpret=self.interpret
+            ticket = ici_fanout.fanout_start(
+                "shard", flat, plan.ring_devices, src=0, slot=slot,
+                interpret=self.interpret,
             )
             m.add_time("ici.fanout", time.perf_counter() - t0)
             t1 = time.perf_counter()
             result = _finish_shard_call(
                 self._mesh_key, plan.shape, dtype_name, plan.split_dim,
                 plan.split_axes, plan.rest_axes,
-            )(self._onto_mesh(out, plan))
+            )(self._onto_mesh(ici_fanout.fanout_wait(ticket), plan))
             m.add_time("ici.redistribute", time.perf_counter() - t1)
         key = (plan.shape, np.dtype(plan.dtype).name)
         if key not in self._validated:
@@ -499,15 +597,55 @@ class IciDistributor:
             # dispatch returns before the ring kernel runs — surfaces
             # HERE, inside distribute()'s try/except, and latches the
             # xla fallback instead of stranding the consumer's
-            # block_until_ready.  Steady-state windows stay async.
+            # block_until_ready.  Steady-state windows stay async (the
+            # fused wait is the consuming step's first use of the data).
             import jax
 
-            jax.block_until_ready(result)
+            ici_fanout.fanout_wait(ticket, sync=True)  # ddl-lint: disable=DDL020 - bring-up validation, once per geometry
+            jax.block_until_ready(result)  # ddl-lint: disable=DDL020 - bring-up validation, once per geometry
             self._validated.add(key)
+        # Landing-slot bookkeeping: cycle the slot AFTER a successful
+        # dispatch (an exception re-routes through the ladder without
+        # burning the slot), count the fused window, and refresh the
+        # slots-in-flight gauge from a non-blocking readiness probe.
+        if plan.n_slots > 1:
+            self._slot = (slot + 1) % plan.n_slots
+            m.incr("ici.fused_windows")
+        self._track_in_flight(result)
         m.incr("ici.bytes", float(plan.wire_bytes))
         m.incr("ici.windows")
         m.set_gauge("ici.peak_bytes", float(plan.peak_bytes))
         return result
+
+    def _track_in_flight(self, result: Any) -> None:
+        """Sweep resolved windows, record ``result``, refresh the
+        ``ici.slots_in_flight`` gauge (high-water on ``.max``) — all
+        non-blocking; tracking is observability, never a wait.
+
+        Entries are WEAK references: after the stream's last window
+        there is no next dispatch to sweep on, and a strong reference
+        would pin up to ``n_slots`` window-sized device buffers for the
+        distributor's remaining life.  The consumer dropping the window
+        releases the tracking with it."""
+        import weakref
+
+        self._in_flight = [
+            r for r in self._in_flight
+            if r() is not None and not _value_ready(r())
+        ]
+        # Every survivor of the sweep is by construction alive and
+        # unresolved, so occupancy is the survivor count plus one probe
+        # of the new result — no second pass over the tracked set.
+        occupied = len(self._in_flight) + (
+            0 if _value_ready(result) else 1
+        )
+        try:
+            self._in_flight.append(weakref.ref(result))
+        except TypeError:
+            pass  # non-weakrefable value: skip tracking, never pin
+        del self._in_flight[: -max(1, self.n_slots)]  # bounded
+        occupied = min(occupied, self.n_slots)
+        self.metrics.set_gauge("ici.slots_in_flight", float(occupied))
 
     def _onto_mesh(self, ring_out: Any, plan: DistributionPlan) -> Any:
         """Zero-copy reinterpretation of the ring's block-per-device
@@ -532,6 +670,12 @@ class IciDistributor:
                 "fallback to the xla path", why,
             )
         self.faulted = True
+        # Drop the in-flight tracking but never the windows themselves:
+        # an already-dispatched slot resolves on its own device-side
+        # semaphores — the latch only re-routes FUTURE windows, so a
+        # mid-fused-step failure cannot strand a started slot.
+        self._in_flight = []
+        self.metrics.set_gauge("ici.slots_in_flight", 0.0)
         self.metrics.incr("ici.fallbacks")
 
     def _xla_fallback(self, block: Any) -> Any:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from jax.sharding import PartitionSpec as P
@@ -312,32 +313,66 @@ class Trainer:
         window_hook: Any = None,
         hook_state: Any = None,
         stream_lookahead: int = 1,
+        fused: Optional[bool] = None,
     ) -> FitResult:
         """One multistep scan per streamed window (see ``fit`` docstring).
 
-        The per-epoch loss read-back is deferred by one window so the
-        host sync of scan k never blocks the enqueue of scan k+1 or the
-        stream of window k+2.  With the staged engine (default), each
-        window's ring slot is released once its staging copy lands, so
-        producers refill while transfers and scans overlap.
+        Two disciplines, selected by ``fused`` (default: the
+        ``DDL_TPU_FUSED`` gate, on):
+
+        - **Fused** (:meth:`_fused_stream_loop`): the whole data plane
+          hides under the train step.  Window N+1's transfer — and on a
+          multi-device mesh its double-buffered ICI fan-out ring
+          (``IciDistributor``'s landing slots) — is dispatched before
+          scan N, the slot release is gated on the CONSUMING step's
+          done-future (``loader.gate_release_on``), and the per-epoch
+          loss read-back is deferred by one window so the host sync of
+          scan k never blocks the enqueue of scan k+1 or the stream of
+          window k+2.
+        - **Synchronous** (:meth:`_sync_stream_loop`,
+          ``DDL_TPU_FUSED=0``): the window lands
+          (``block_until_ready``) before the step is dispatched and the
+          losses are read back before the next acquire — measured step
+          time is compute + ingest, not max().  This is the bench A/B's
+          unfused baseline and the discipline every fallback rung
+          degrades toward; it must stay loss-identical to the fused
+          loop (same data, same math, different dispatch timing).
         """
-        from ddl_tpu import Marker
         from ddl_tpu.parallel.train import make_multistep
 
         col_splits = _stream_splits(loader)
+        if fused is None:
+            from ddl_tpu.parallel.ici import fused_enabled
+
+            fused = fused_enabled()
+
+        # Window-stream scans are UNDONATED on the CPU client: a
+        # donated jit call executes SYNCHRONOUSLY there (measured —
+        # dispatch blocks for the whole execution), which collapses the
+        # async dispatch queue the stream's overlap (and the whole
+        # fused step) rides on.  Accelerator runtimes pipeline donated
+        # buffers fine, so the chip path keeps donation (undonated
+        # params + optimizer state would double peak HBM — DDL017's
+        # whole point); on CPU the second buffer is the price of the
+        # entire data plane hiding under the step.
+        donate = all(
+            getattr(d, "platform", "cpu") != "cpu"
+            for d in self.mesh.devices.flat
+        )
 
         def multi_for(n_steps: int):
             # Resolved PER WINDOW: with mixed batches_per_window across
             # producers, windows of different depths arrive as the
             # rotation advances, each needing its own scan length
-            # (compiled once per distinct depth, cached).
+            # (compiled once per distinct depth, cached — ``donate`` is
+            # constant per trainer, so depth alone keys the cache).
             fn = self._multistep_cache.pop(n_steps, None)
             if fn is None:
                 _, fn = make_multistep(
                     self._loss_fn, self._optimizer, self.mesh,
                     self._param_specs, batch_spec=self._batch_spec,
                     n_steps=n_steps, accum_steps=self._accum_steps,
-                    **self._opt_kwargs,
+                    donate=donate, **self._opt_kwargs,
                 )
             # Re-insert at the MRU end (dict preserves insertion order);
             # trim the LRU end past the cap.
@@ -348,36 +383,12 @@ class Trainer:
                 )
             return fn
 
-        pending = None
-        epoch = start_epoch
         stream = loader.windows(lookahead=stream_lookahead)
-        _done = object()
-        while True:
-            # Window-wait accounting: with healthy overlap the next
-            # window is already in flight while the previous scan runs,
-            # so this wait stays near zero; it flows into
-            # north_star_report["window_wait_s"] and the bench JSON.
-            with self.metrics.timed("trainer.window_wait"):
-                win = next(stream, _done)
-            if win is _done:
-                break
-            if window_hook is not None:
-                win = window_hook(win)
-            state, losses = multi_for(win.shape[0])(
-                state, _window_cols(win, col_splits), per_step=True
-            )
-            if pending is not None:
-                epoch_losses.append(float(pending.mean()))
-            pending = losses
-            epoch += 1
-            loader.mark(Marker.END_OF_EPOCH)
-            if (
-                self.checkpoint_dir is not None
-                and epoch % self.checkpoint_every_epochs == 0
-            ):
-                self._checkpoint(state, loader, shuffler=hook_state)
-        if pending is not None:
-            epoch_losses.append(float(pending.mean()))
+        loop = self._fused_stream_loop if fused else self._sync_stream_loop
+        state = loop(
+            loader, stream, state, multi_for, col_splits, window_hook,
+            hook_state, epoch_losses, start_epoch,
+        )
         for i, mean in enumerate(epoch_losses):
             logger.info(
                 "trainer: epoch %d/%d mean loss %.6f (windowed)",
@@ -390,6 +401,125 @@ class Trainer:
             resumed_from_epoch=start_epoch,
             metrics=self.metrics,
         )
+
+    def _fused_stream_loop(
+        self, loader, stream, state, multi_for, col_splits, window_hook,
+        hook_state, epoch_losses, start_epoch,
+    ):
+        """The fused compute/ingest step (DDL020: no host syncs).
+
+        Per window: acquire (the data plane already dispatched it under
+        the previous scan), dispatch the scan, hand the scan's
+        done-future to the loader (``gate_release_on`` — slot release
+        waits for the CONSUMER, not the transfer), then read back the
+        PREVIOUS window's losses.  That deferred read-back is the only
+        host sync, it blocks on a scan that is already one window old
+        (bounding in-flight depth at two — the landing-slot count), and
+        the overlap it buys is measured: the acquire span of window k+1
+        while scan k is still computing accumulates into
+        ``trainer.ingest_overlap`` (a LOWER bound on hidden ingest:
+        spans whose scan finished mid-acquire are not counted).
+        """
+        from ddl_tpu import Marker
+        from ddl_tpu.utils import value_ready
+
+        m = self.metrics
+        pending = None
+        epoch = start_epoch
+        _done = object()
+        while True:
+            # Window-wait accounting: with healthy overlap the next
+            # window is already in flight while the previous scan runs,
+            # so this wait stays near zero; it flows into
+            # north_star_report["window_wait_s"] and the bench JSON.
+            t0 = time.perf_counter()
+            with m.timed("trainer.window_wait"):
+                win = next(stream, _done)
+            # Ready-by-default polarity: an unprobeable future must
+            # never inflate the overlap measurement.
+            if pending is not None and not value_ready(pending, True):
+                # The previous scan computed through this whole acquire:
+                # the data plane was hidden under the step.
+                m.add_time(
+                    "trainer.ingest_overlap", time.perf_counter() - t0
+                )
+            if win is _done:
+                break
+            if window_hook is not None:
+                win = window_hook(win)
+            state, losses = multi_for(win.shape[0])(
+                state, _window_cols(win, col_splits), per_step=True
+            )
+            # The epoch-loss reduction is dispatched HERE, right behind
+            # its own scan: backends that execute in dispatch order
+            # (the CPU client) would otherwise queue a read-time
+            # ``pending.mean()`` behind the NEXT scan, silently
+            # re-serializing the loop the fused step exists to overlap.
+            loss_mean = losses.mean()
+            loader.gate_release_on(losses)
+            m.incr("trainer.fused_windows")
+            if pending is not None:
+                # Deferred ONE window: blocks on the PREVIOUS scan's
+                # already-queued reduction, bounding in-flight depth at
+                # the landing-slot count.
+                epoch_losses.append(float(pending))
+            pending = loss_mean
+            epoch += 1
+            loader.mark(Marker.END_OF_EPOCH)
+            if (
+                self.checkpoint_dir is not None
+                and epoch % self.checkpoint_every_epochs == 0
+            ):
+                self._checkpoint(state, loader, shuffler=hook_state)
+        if pending is not None:
+            # Stream drained; the final scan must be consumed.
+            epoch_losses.append(float(pending))
+        return state
+
+    def _sync_stream_loop(
+        self, loader, stream, state, multi_for, col_splits, window_hook,
+        hook_state, epoch_losses, start_epoch,
+    ):
+        """The synchronous (unfused) discipline — ``DDL_TPU_FUSED=0``.
+
+        The window lands, THEN compute starts, THEN the losses are read
+        back: measured step time is compute + ingest.  Kept as (a) the
+        explicit escape hatch, (b) the fused A/B's baseline leg in the
+        bench, and (c) the behavior every degradation rung falls back
+        toward — bit-identical losses to the fused loop by
+        construction (same windows, same compiled scans, different
+        dispatch timing only).
+        """
+        import jax
+
+        from ddl_tpu import Marker
+
+        epoch = start_epoch
+        _done = object()
+        while True:
+            with self.metrics.timed("trainer.window_wait"):
+                win = next(stream, _done)
+                if win is not _done:
+                    # "The window lands...": expose the whole transfer.
+                    jax.block_until_ready(win)
+            if win is _done:
+                break
+            if window_hook is not None:
+                win = window_hook(win)
+            state, losses = multi_for(win.shape[0])(
+                state, _window_cols(win, col_splits), per_step=True
+            )
+            # "...then compute runs to completion": immediate read-back
+            # serializes the next acquire behind this scan.
+            epoch_losses.append(float(losses.mean()))
+            epoch += 1
+            loader.mark(Marker.END_OF_EPOCH)
+            if (
+                self.checkpoint_dir is not None
+                and epoch % self.checkpoint_every_epochs == 0
+            ):
+                self._checkpoint(state, loader, shuffler=hook_state)
+        return state
 
     # -- the run -----------------------------------------------------------
 
@@ -409,6 +539,7 @@ class Trainer:
         window_stream: Optional[bool] = None,
         window_hook: Any = None,
         stream_lookahead: int = 1,
+        fused: Optional[bool] = None,
         config: Any = None,
     ) -> FitResult:
         """Run the full producer/consumer training job; returns FitResult.
@@ -443,6 +574,14 @@ class Trainer:
         stream's in-flight pipeline (``DistributedDataLoader.windows``'s
         ``lookahead``); with the staged ingest engine early slot release
         lets the same ``nslots`` sustain the deeper pipeline.
+
+        ``fused`` (window-stream mode only; default: the
+        ``DDL_TPU_FUSED`` env gate, on) selects the fused
+        compute/ingest step — the data plane dispatched under the train
+        step, slot release gated on the consuming step's done-future —
+        vs the synchronous discipline (window lands, then compute, then
+        loss read-back).  Loss-identical either way; only dispatch
+        timing differs (see ``_fit_windows``).
 
         Under PROCESS/MULTIHOST modes call this from under
         ``if __name__ == "__main__":`` (multiprocessing spawn re-imports
@@ -486,6 +625,8 @@ class Trainer:
             raise ValueError("window_stream requires output='jax'")
         if window_hook is not None and not window_stream:
             raise ValueError("window_hook requires window_stream=True")
+        if fused is not None and not window_stream:
+            raise ValueError("fused requires window_stream=True")
         # A stateful hook provider (DeviceGlobalShuffler or anything with
         # a .window_hook() factory) is passed WHOLE so the trainer can
         # checkpoint/restore its round state with the loader clock.  A
@@ -587,7 +728,7 @@ class Trainer:
                     return trainer._fit_windows(
                         loader, state, start_epoch, n_epochs, epoch_losses,
                         window_hook=window_hook, hook_state=hook_state,
-                        stream_lookahead=stream_lookahead,
+                        stream_lookahead=stream_lookahead, fused=fused,
                     )
                 finally:
                     if wd is not None:

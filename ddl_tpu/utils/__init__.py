@@ -121,3 +121,28 @@ def for_all_methods(
         return cls
 
     return deco
+
+
+def value_ready(value: Any, default: bool) -> bool:
+    """Non-blocking completion probe on a device value (a jax array or
+    pytree of them) — the ONE implementation behind the loader's
+    transfer-gated release sweep and the fused step's overlap /
+    slots-in-flight accounting, which must observe progress without
+    ever waiting for it.
+
+    ``default`` is the answer for leaves without ``is_ready`` (older
+    jax, or duck-typed futures missing the probe), and the polarity is
+    the caller's SAFETY direction: the release sweep passes ``False``
+    (report not-ready — the forced blocking flush still frees the slot
+    correctly, the fast path just never triggers), while the
+    observability probes pass ``True`` (gauges degrade to zero rather
+    than the probe becoming a sync).
+    """
+    try:
+        import jax
+
+        return all(
+            bool(leaf.is_ready()) for leaf in jax.tree.leaves(value)
+        )
+    except AttributeError:
+        return default

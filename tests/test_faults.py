@@ -311,6 +311,54 @@ class TestFaultMatrix:
         assert m.counter("integrity.corrupt_windows") == 0
         assert not faulted
 
+    def test_ici_dma_fail_mid_fused_stream_latches_sync_fallback(self):
+        """ICI_DMA_FAIL fired mid-fused-stream (the two-slot ingest
+        tier active on the virtual mesh): the distributor latches the
+        synchronous xla fallback for the rest of the run WITHOUT
+        stranding the in-flight landing slot (already-dispatched
+        windows resolve on their own semaphores) or the consumer's
+        release backlog, and the served stream stays byte-identical —
+        the fused protocol's degradation rung."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        m = Metrics()
+        n_epochs = 6
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        sharding = NamedSharding(mesh, P(None, "dp"))
+        plan = FaultPlan(
+            [FaultSpec("ici.fanout", FaultKind.ICI_DMA_FAIL, at=3)]
+        )
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                PatternProducer(), batch_size=N_DATA,
+                connection=env.connection, n_epochs=n_epochs,
+                output="jax", timeout_s=60.0, metrics=m,
+                sharding=sharding, distribute="ici",
+            )
+            windows = []
+            for win in loader.windows():
+                windows.append(np.asarray(win).reshape(SHAPE).copy())
+                loader.mark(Marker.END_OF_EPOCH)
+            assert not loader._release_backlog  # nothing stranded
+            return windows, loader._ingestor._ici
+
+        with faults.armed(plan):
+            windows, dist = main()
+        assert_byte_identical(windows, n_epochs)
+        assert plan.fired and plan.fired[0][1] == "ici_dma_fail"
+        assert dist.faulted  # latched: the rest of the run rode xla
+        assert m.counter("ici.fallbacks") == 1
+        # Exactly the pre-fault windows rode the fused ICI tier; the
+        # fault window and every later one took the synchronous path.
+        assert m.counter("ici.windows") == 2
+        assert m.counter("ici.fused_windows") == 2
+        # The latch cleared the landing-slot tracking (no phantom
+        # occupancy), while the high-water proves slots were used.
+        assert m.gauge("ici.slots_in_flight") == 0.0
+
     def test_shuffle_peer_loss_degrades_to_local(self):
         """Exchange partner lost: the round degrades to a node-local
         shuffle (loud warning + metric) instead of stalling; after

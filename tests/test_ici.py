@@ -590,3 +590,226 @@ class TestReaderStreamByteIdentity:
                 window_rows=32, warm=False,
             )
         )
+
+
+# -- the fused two-slot protocol ----------------------------------------------
+
+
+class TestTwoSlotFused:
+    """Double-buffered device-side landing slots (ISSUE 12): per-slot
+    collective-id pairs + landing buffers, the split start/wait ticket
+    surface, fused plan pricing, the slots-in-flight gauge, remat
+    compatibility of the async legs, and the never-strand guarantee of
+    a mid-fused-stream latch."""
+
+    def _sharding(self):
+        return NamedSharding(_mesh((("dp", 8),)), P("dp"))
+
+    def test_per_slot_collective_ids_are_disjoint(self):
+        """Two concurrently-running ring kernels must never share
+        barrier semaphores: the slot-indexed Mosaic collective ids are
+        pairwise distinct across modes AND slots."""
+        ids = (
+            ici_fanout._BCAST_COLLECTIVE_IDS
+            + ici_fanout._SCATTER_COLLECTIVE_IDS
+        )
+        assert len(ids) == 2 * ici_fanout.N_SLOTS
+        assert len(set(ids)) == len(ids)
+
+    def test_slot_out_of_range_rejected(self):
+        devs = _ring(2)
+        x = jax.device_put(
+            np.arange(8 * 4, dtype=np.float32).reshape(8, 4), devs[0]
+        )
+        with pytest.raises(ValueError, match="landing slot"):
+            ici_fanout.fanout_replicate(x, devs, slot=ici_fanout.N_SLOTS)
+        with pytest.raises(ValueError, match="landing slot"):
+            ici_fanout.fanout_shard(x, devs, slot=-1)
+
+    def test_ticket_roundtrip_both_modes(self):
+        devs = _ring(4)
+        x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+        blk = jax.device_put(x, devs[0])
+        t_rep = ici_fanout.fanout_start("replicate", blk, devs, slot=0)
+        t_shard = ici_fanout.fanout_start("shard", blk, devs, slot=1)
+        assert (t_rep.mode, t_rep.slot) == ("replicate", 0)
+        assert (t_shard.mode, t_shard.slot) == ("shard", 1)
+        out = ici_fanout.fanout_wait(t_rep, sync=True)
+        for i in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(out[i * 8 : (i + 1) * 8]), x
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ici_fanout.fanout_wait(t_shard)), x
+        )
+        with pytest.raises(ValueError, match="replicate|shard"):
+            ici_fanout.fanout_start("gather", blk, devs)
+
+    def test_two_in_flight_tickets_land_byte_identical(self):
+        """The literal double-buffer: window B's ring is started before
+        window A's is waited on — both land intact (per-slot landing
+        buffers + collective ids keep them off each other)."""
+        devs = _ring(4)
+        a = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+        b = a + 1000.0
+        ta = ici_fanout.fanout_start(
+            "replicate", jax.device_put(a, devs[0]), devs, slot=0
+        )
+        tb = ici_fanout.fanout_start(
+            "replicate", jax.device_put(b, devs[0]), devs, slot=1
+        )
+        out_a = ici_fanout.fanout_wait(ta)
+        out_b = ici_fanout.fanout_wait(tb)
+        for i in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(out_a[i * 8 : (i + 1) * 8]), a
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out_b[i * 8 : (i + 1) * 8]), b
+            )
+
+    def test_landing_buffers_are_per_slot(self):
+        devs = _ring(2)
+        l0 = ici_fanout._landing_buffers(devs, 4, 4, "float32", 0, 0)
+        l1 = ici_fanout._landing_buffers(devs, 4, 4, "float32", 0, 1)
+        assert l0 is not l1  # distinct cached sets
+        assert l0[1] is not l1[1]  # distinct device buffers
+        assert ici_fanout._landing_buffers(devs, 4, 4, "float32", 0, 0) is l0
+
+    def test_fused_plan_prices_both_slots(self):
+        """n_slots=2 carries one extra in-flight fan-out through every
+        leg: the fused peak is exactly twice the single-slot peak for a
+        replicate plan (landing + output per slot), its legs are marked
+        asynchronous, and the default bound scales with the slots."""
+        sharding = self._sharding()
+        p1 = plan_distribution((16, 8), np.float32, sharding, n_slots=1)
+        p2 = plan_distribution((16, 8), np.float32, sharding, n_slots=2)
+        assert p1.n_slots == 1 and p2.n_slots == 2
+        assert p2.peak_bytes == 2 * p1.peak_bytes
+        assert not any(leg.asynchronous for leg in p1.legs)
+        assert all(
+            leg.asynchronous for leg in p2.legs if "fanout" in leg.kind
+        )
+        # The single-slot bound rejects a fused REPLICATE plan's
+        # doubled peak (2 × (landing + output + chunk) > 3.0 windows).
+        replicated = NamedSharding(_mesh((("dp", 8),)), P(None, None))
+        with pytest.raises(PlanError, match="memory bound"):
+            plan_distribution(
+                (16, 8), np.float32, replicated, n_slots=2,
+                max_memory_factor=DEFAULT_MEMORY_FACTOR,
+            )
+
+    def test_fused_shard_plan_prices_extra_slot_through_every_leg(self):
+        sharding = NamedSharding(
+            _mesh((("dp", 4), ("fsdp", 2))), P("dp", None)
+        )
+        p1 = plan_distribution((16, 8), np.float32, sharding, n_slots=1)
+        p2 = plan_distribution((16, 8), np.float32, sharding, n_slots=2)
+        nbytes = 16 * 8 * 4
+        slot_live = nbytes + 3 * (nbytes // 8)
+        for l1, l2 in zip(p1.legs, p2.legs):
+            assert l2.peak_bytes == l1.peak_bytes + slot_live
+        assert p2.peak_factor <= 2 * DEFAULT_MEMORY_FACTOR
+
+    def test_distributor_cycles_slots_and_counts(self):
+        m = Metrics()
+        dist = IciDistributor(self._sharding(), metrics=m, n_slots=2)
+        x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        outs = [dist.put(x + k, jax.device_put) for k in range(4)]
+        for k, out in enumerate(outs):
+            np.testing.assert_array_equal(np.asarray(out), x + k)
+        assert m.counter("ici.windows") == 4
+        assert m.counter("ici.fused_windows") == 4
+        assert m.counter("ici.fallbacks") == 0
+        # The gauge is bounded by the slot count and its high-water
+        # never exceeds the double-buffer depth.
+        assert m.gauge("ici.slots_in_flight.max") <= 2.0
+
+    def test_single_slot_distributor_never_counts_fused(self):
+        m = Metrics()
+        dist = IciDistributor(self._sharding(), metrics=m, n_slots=1)
+        x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        dist.put(x, jax.device_put)
+        dist.put(x, jax.device_put)
+        assert m.counter("ici.windows") == 2
+        assert m.counter("ici.fused_windows") == 0
+        assert dist.plan(x.shape, x.dtype).n_slots == 1
+
+    def test_env_hatch_disables_fused(self, monkeypatch):
+        from ddl_tpu.parallel.ici import fused_enabled
+
+        monkeypatch.setenv("DDL_TPU_FUSED", "0")
+        assert not fused_enabled()
+        dist = IciDistributor(self._sharding())
+        assert dist.n_slots == 1
+        monkeypatch.setenv("DDL_TPU_FUSED", "1")
+        assert fused_enabled()
+        assert IciDistributor(self._sharding()).n_slots == 2
+
+    def test_fused_memory_bound_scales_with_slots(self):
+        from ddl_tpu.parallel.ici import DEFAULT_MEMORY_FACTOR as DMF
+
+        d1 = IciDistributor(self._sharding(), n_slots=1)
+        d2 = IciDistributor(self._sharding(), n_slots=2)
+        assert d1.max_memory_factor == DMF
+        assert d2.max_memory_factor == 2 * DMF
+        # An explicit factor always wins over the scaling default.
+        d3 = IciDistributor(
+            self._sharding(), n_slots=2, max_memory_factor=9.0
+        )
+        assert d3.max_memory_factor == 9.0
+
+    def test_remat_consumer_never_reexecutes_async_legs(self):
+        """The start/wait pair survives jax.checkpoint: a rematerialized
+        consumer recomputes its own activations from the landed window
+        (an INPUT to the checkpointed function) without re-running the
+        DMA ring — ici.windows counts each window exactly once, and the
+        grads match the unrematerialized reference bit-exactly."""
+        m = Metrics()
+        dist = IciDistributor(self._sharding(), metrics=m, n_slots=2)
+        x = np.random.default_rng(0).random((16, 4)).astype(np.float32)
+        win = dist.put(x, jax.device_put)
+        assert m.counter("ici.windows") == 1
+
+        def loss(p, w):
+            return ((w * p) ** 2).sum()
+
+        ck = jax.jit(
+            jax.grad(
+                jax.checkpoint(  # noqa: loss recomputed, window not
+                    loss,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            )
+        )
+        ref = jax.jit(jax.grad(loss))
+        g_ck = ck(2.0, win)
+        g_ref = ref(2.0, win)
+        np.testing.assert_array_equal(np.asarray(g_ck), np.asarray(g_ref))
+        # The fan-out never re-executed under remat: still one window.
+        assert m.counter("ici.windows") == 1
+
+    def test_latch_mid_fused_never_strands_in_flight_window(self):
+        """A DMA failure on window 2 with window 1's slot still in
+        flight: window 1 resolves byte-identical (its ring program owns
+        its own semaphores), window 2 re-routes through xla, the latch
+        clears the slot tracking, and later windows stay correct."""
+        m = Metrics()
+        dist = IciDistributor(self._sharding(), metrics=m, n_slots=2)
+        x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        plan = FaultPlan(
+            [FaultSpec("ici.fanout", FaultKind.ICI_DMA_FAIL, at=2)]
+        )
+        with faults.armed(plan):
+            out1 = dist.put(x, jax.device_put)  # healthy, slot 0
+            out2 = dist.put(x + 1, jax.device_put)  # faults -> xla
+            out3 = dist.put(x + 2, jax.device_put)  # latched -> xla
+        np.testing.assert_array_equal(np.asarray(out1), x)
+        np.testing.assert_array_equal(np.asarray(out2), x + 1)
+        np.testing.assert_array_equal(np.asarray(out3), x + 2)
+        assert dist.faulted
+        assert m.counter("ici.fallbacks") == 1
+        assert m.counter("ici.windows") == 1
+        assert m.counter("ici.fused_windows") == 1
+        assert m.gauge("ici.slots_in_flight") == 0.0
+        assert dist._in_flight == []

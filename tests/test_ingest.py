@@ -202,6 +202,9 @@ class TestNorthStarReport:
             # ICI ingest tier extras (ISSUE 7: ddl_tpu/parallel/ici)
             "ici_bytes", "ici_windows", "ici_fallbacks",
             "ici_fanout_s", "ici_redistribute_s", "ici_peak_bytes",
+            # fused compute/ingest step extras (ISSUE 12: overlap
+            # proof + two-slot landing occupancy)
+            "ingest_overlap_s", "fused_windows", "slots_in_flight",
             # distributed-optimizer extras (ISSUE 8:
             # ddl_tpu/parallel/optimizer)
             "opt_state_bytes_per_replica", "opt_state_bytes_total",
@@ -243,6 +246,128 @@ class TestNorthStarReport:
         # Keyed by tenant NAME only: set_gauge's ".max" companions are
         # filtered, or consumers would see a phantom tenant "alpha.max".
         assert set(r["serve_tenant_stall"]) == {"alpha"}
+
+
+class TestFusedGatedRelease:
+    """``gate_release_on``: the fused-step protocol's loader half —
+    ring-slot release gated on the CONSUMING step's done-future, not
+    the bare transfer (ISSUE 12).  Exercised with a controllable fake
+    future and the accelerator-style inline path forced (the CPU
+    client's detached source releases at yield, where gating is a
+    documented no-op)."""
+
+    class _Future:
+        """Duck-typed device future: non-blocking ``is_ready`` probe +
+        a ``block_until_ready`` the forced flush path may call."""
+
+        def __init__(self):
+            self.ready = False
+            self.forced = False
+
+        def is_ready(self):
+            return self.ready
+
+        def block_until_ready(self):
+            self.forced = True
+            self.ready = True
+            return self
+
+    def _run(self, body):
+        from ddl_tpu.observability import Metrics
+
+        m = Metrics()
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=32, connection=env.connection,
+                n_epochs=3, output="jax", metrics=m,
+            )
+            # Force the accelerator-style inline discipline: treat the
+            # transfer as sourcing the ring slot, so releases ride the
+            # probe-gated backlog instead of happening at yield.
+            loader._ingestor.window_source_detached = lambda: False
+            try:
+                return body(loader, m)
+            finally:
+                loader.shutdown()
+
+        return main()
+
+    def test_release_waits_for_consuming_step(self):
+        def body(loader, m):
+            ring = loader.connection.rings[0]
+            stream = loader.windows(lookahead=0)
+            fut = self._Future()
+            next(stream)
+            assert len(loader._release_backlog) == 1
+            loader.gate_release_on(fut)
+            assert m.counter("ingest.fused_gated") == 1
+            # The transfer itself is long done (CPU), but the consuming
+            # step is not: the sweep at the next acquire must NOT free
+            # the slot.
+            next(stream)
+            assert ring.stats()["released"] == 0
+            assert len(loader._release_backlog) >= 1
+            # Step completes -> the very next sweep frees the slot.
+            fut.ready = True
+            next(stream)
+            assert ring.stats()["released"] >= 1
+            assert not fut.forced  # released by the probe, not a flush
+
+        self._run(body)
+
+    def test_pending_step_future_cannot_deadlock(self):
+        """A gated slot with its step future still pending when the
+        ring runs dry: the forced flush block_until_ready's the
+        COMBINED (transfer, step) future — the stream keeps moving and
+        shutdown drains everything; the protocol can never strand a
+        slot."""
+
+        def body(loader, m):
+            ring = loader.connection.rings[0]
+            stream = loader.windows(lookahead=0)
+            fut = self._Future()
+            next(stream)
+            loader.gate_release_on(fut)
+            # Drain the remaining windows WITHOUT ever resolving the
+            # future ourselves: the ring (nslots=2) exhausts and the
+            # stream's forced flush must wait out the step future.
+            for _ in stream:
+                pass
+            assert fut.forced  # the flush waited on the step, not a spin
+            assert ring.stats()["released"] >= 1
+            # Teardown drains the remaining backlog: every acquired
+            # slot comes back, nothing stranded (idempotent with the
+            # harness's own shutdown).
+            loader.shutdown()
+            assert ring.stats()["released"] == 3
+            assert not loader._release_backlog
+
+        self._run(body)
+
+    def test_gate_is_noop_when_slot_released_at_yield(self):
+        """On the CPU client (detached source) the slot is back with
+        the producer at yield — gating must be a harmless no-op, so a
+        fused trainer runs unchanged on any client."""
+        from ddl_tpu.observability import Metrics
+
+        m = Metrics()
+
+        @distributed_dataloader(n_producers=1, mode="thread")
+        def main(env):
+            loader = DistributedDataLoader(
+                SeqProducer(), batch_size=32, connection=env.connection,
+                n_epochs=2, output="jax", metrics=m,
+            )
+            stream = loader.windows()
+            next(stream)
+            loader.gate_release_on(self._Future())
+            assert m.counter("ingest.fused_gated") == 0
+            assert loader._last_stream_entry is None
+            loader.shutdown()
+
+        main()
 
 
 class TestLoaderPrefetch:

@@ -199,6 +199,20 @@ VIOLATIONS = {
                 for t in self._tenants.values():
                     t.granted.wait(0.05)   # per-tenant wait fan-out
     """,
+    "DDL020": """
+        import jax
+
+        class Trainer:
+            def _fused_stream_loop(self, loader, stream, state, step):
+                for win in stream:
+                    jax.block_until_ready(win)   # exposes the transfer
+                    state, losses = step(state, win)
+                    self.losses.append(float(losses.mean()))  # sync
+
+        class IciDistributor:
+            def _distribute_planned(self, ticket):
+                return fanout_wait(ticket, sync=True)  # forced wait
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -453,6 +467,30 @@ CLEAN = {
             def _helper_outside_config(self):
                 for t in self._tenants:
                     t.done.wait(1.0)   # not a configured serve loop
+    """,
+    "DDL020": """
+        import jax
+
+        class Trainer:
+            def _fused_stream_loop(self, loader, stream, state, step):
+                pending = None
+                for win in stream:
+                    if pending is not None and not _value_ready(pending):
+                        self.overlap += 1       # non-blocking probe: clean
+                    state, losses = step(state, win)
+                    loader.gate_release_on(losses)
+                    nbytes = float(win.nbytes)  # host arithmetic: clean
+                    self.bytes += nbytes
+                    pending = losses
+                return state
+
+            def _sync_stream_loop(self, stream, state, step):
+                for win in stream:
+                    jax.block_until_ready(win)  # not a configured function
+
+        class IciDistributor:
+            def _distribute_planned(self, ticket):
+                return fanout_wait(ticket)      # data-dependence wait: clean
     """,
 }
 

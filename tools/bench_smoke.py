@@ -45,12 +45,19 @@ Four passes:
    coverage, and zero watchdog failures.
 3. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
-   schedule/bubble gauges) and its `pipeline_overhead` against the
-   matched no-loader ceiling must be <= PIPELINE_OVERHEAD_MAX.  The
-   overhead gate retries once: the 2-core box's one-sided noise
-   occasionally inflates a single run by more than the gate margin,
-   while the regression this gate exists to catch (the per-window
-   blocking sync, r5) measured 0.10-0.12 on EVERY run.
+   schedule/bubble gauges, the ISSUE-12 fused extras) and the FUSED
+   leg's `pipeline_overhead` against the matched no-loader ceiling
+   must be <= PIPELINE_OVERHEAD_MAX **at a geometry where the same
+   run's UNFUSED leg shows >= UNFUSED_OVERHEAD_MIN** — the A/B proves
+   the fused step actually hides the data plane, not merely that the
+   pipeline is cheap.  Also asserted: the fused/unfused streams are
+   byte-identical (deterministic, never retried), and the published
+   headline is the measured winner (never-slower, with a matching
+   `winner` label).  The measured gates retry once: the 2-core box's
+   one-sided noise occasionally inflates a single run by more than the
+   gate margin, while the regression the fused gate exists to catch
+   (the per-window blocking sync, r5) measured 0.10-0.12 on EVERY run
+   — which is exactly what the unfused leg re-creates on purpose.
 
 Exit 0 on success; nonzero with a reason on any violation.
 """
@@ -86,14 +93,22 @@ MIN_PROCESS_VS_THREAD = 0.9
 MIN_VS_BASELINE = 1.0
 #: last_tpu_artifact summary keys (present whenever the block is a dict).
 REQUIRED_ARTIFACT = ("path", "metric", "value", "unit")
-#: fit_stream contract (ISSUE 5): throughput + matched ceiling +
-#: overlap-health counters + schedule gauges.
+#: fit_stream contract (ISSUE 5 + 12): throughput + matched ceiling +
+#: overlap-health counters + schedule gauges + the fused A/B block.
 REQUIRED_FIT = (
     "tokens_per_sec", "ceiling_tokens_per_sec", "pipeline_overhead",
     "window_wait_s", "release_wait_s", "schedule", "pp_bubble",
+    "fused", "unfused", "fused_vs_unfused", "winner", "byte_identical",
+    "ingest_overlap_s", "fused_windows", "slots_in_flight",
+    "simulated_dma_ms",
 )
-#: Stream-fit overhead ceiling vs the matched no-loader scan (CPU).
+#: Stream-fit overhead ceiling vs the matched no-loader scan (CPU) —
+#: the FUSED leg's gate.
 PIPELINE_OVERHEAD_MAX = 0.02
+#: The same run's UNFUSED (synchronous) leg must expose at least this
+#: much ingest at the same geometry — otherwise the fused gate proves
+#: nothing (there was no data plane to hide).
+UNFUSED_OVERHEAD_MIN = 0.10
 #: Overhead-gate attempts (key presence is never retried).
 FIT_ATTEMPTS = 2
 #: Staged-engine extras (north_star_report staging block).
@@ -678,8 +693,7 @@ def main() -> int:
             "recovery was misreported as failure"
         )
         return 1
-    # -- pass 3: the training hot path (ISSUE 5) -----------------------
-    overheads = []
+    # -- pass 3: the fused training hot path (ISSUE 5 + 12) ------------
     for attempt in range(1, FIT_ATTEMPTS + 1):
         train = _run_bench("train")
         if train is None:
@@ -697,21 +711,59 @@ def main() -> int:
             print(json.dumps(fit, indent=1))
             print(f"bench-smoke: fit_stream missing keys: {fit_missing}")
             return 1
-        overheads.append(fit["pipeline_overhead"])
-        if fit["pipeline_overhead"] <= PIPELINE_OVERHEAD_MAX:
+        fit_pair = {
+            "fused": fit["fused"]["tokens_per_sec"],
+            "unfused": fit["unfused"]["tokens_per_sec"],
+        }
+        fit_problems = []
+        if fit["fused"]["pipeline_overhead"] > PIPELINE_OVERHEAD_MAX:
+            fit_problems.append(
+                "fused pipeline_overhead "
+                f"{fit['fused']['pipeline_overhead']} > "
+                f"{PIPELINE_OVERHEAD_MAX} — the fused step is not "
+                "hiding the data plane"
+            )
+        if fit["unfused"]["pipeline_overhead"] < UNFUSED_OVERHEAD_MIN:
+            fit_problems.append(
+                "unfused pipeline_overhead "
+                f"{fit['unfused']['pipeline_overhead']} < "
+                f"{UNFUSED_OVERHEAD_MIN} — the geometry exposes too "
+                "little ingest for the fused gate to prove anything"
+            )
+        if fit["tokens_per_sec"] < max(fit_pair.values()):
+            fit_problems.append(
+                f"fit_stream headline {fit['tokens_per_sec']} is slower "
+                f"than a discipline the same run measured ({fit_pair}) "
+                "— never-slower invariant violated"
+            )
+        if (
+            fit["winner"] not in fit_pair
+            or fit_pair[fit["winner"]] < max(fit_pair.values())
+        ):
+            fit_problems.append(
+                f"fit_stream winner label {fit['winner']!r} does not "
+                f"name the measured winner ({fit_pair})"
+            )
+        if not fit_problems:
             break
         if attempt < FIT_ATTEMPTS:
             print(
-                "bench-smoke: fit_stream.pipeline_overhead "
-                f"{fit['pipeline_overhead']} > {PIPELINE_OVERHEAD_MAX}; "
-                "retrying once (one-sided box noise)"
+                f"bench-smoke: fit_stream gates failed ({fit_problems});"
+                " retrying once (one-sided box noise)"
             )
-    if min(overheads) > PIPELINE_OVERHEAD_MAX:
+            continue
+        print(json.dumps(fit, indent=1))
+        for p in fit_problems:
+            print(f"bench-smoke: {p}")
+        return 1
+    # Deterministic: the fused and unfused streams must serve the SAME
+    # bytes (CRC'd per window through the window_hook seam) — never
+    # retried.
+    if fit["byte_identical"] is not True:
         print(json.dumps(fit, indent=1))
         print(
-            "bench-smoke: fit_stream.pipeline_overhead "
-            f"{overheads} > {PIPELINE_OVERHEAD_MAX} in every attempt — "
-            "the window stream is not overlap-correct"
+            "bench-smoke: fused stream NOT byte-identical to unfused — "
+            "the fused protocol changed data"
         )
         return 1
 
@@ -737,9 +789,12 @@ def main() -> int:
         f"({tn['n_tenants']} tenants, reaction "
         f"{tn['scale_up_reaction_s']}s, chaos byte-correct, "
         f"watchdog_failures={tn_chaos['watchdog_failures']}); "
-        "fit_stream overhead "
-        f"{min(overheads)} <= {PIPELINE_OVERHEAD_MAX} "
-        f"(window_wait_s={fit['window_wait_s']})"
+        "fit_stream fused "
+        f"{fit['fused']['pipeline_overhead']} <= {PIPELINE_OVERHEAD_MAX} "
+        f"where unfused {fit['unfused']['pipeline_overhead']} >= "
+        f"{UNFUSED_OVERHEAD_MIN} (winner {fit['winner']}, "
+        f"fused_vs_unfused {fit['fused_vs_unfused']}, byte-identical, "
+        f"window_wait_s={fit['window_wait_s']})"
     )
     return 0
 

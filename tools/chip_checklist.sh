@@ -31,7 +31,7 @@ ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/7] probe =="
+echo "== [1/8] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -41,23 +41,23 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/7] on-chip test suite =="
+echo "== [2/8] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/7] full bench =="
+echo "== [3/8] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/7] big-model MFU bench =="
+echo "== [4/8] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
 
-echo "== [4b/7] serving decode bench (small + big, MBU-graded) =="
+echo "== [4b/8] serving decode bench (small + big, MBU-graded) =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
   2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
-echo "== [5/7] stream-bandwidth diagnosis + window-size sweep =="
+echo "== [5/8] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
 # fail loudly (step timeout), never silently record CPU numbers in a
 # TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
@@ -77,7 +77,7 @@ for MIB in 64 128; do
     | tee "$ART/bench-stream-$MIB-$STAMP.json"
 done
 
-echo "== [6/7] ICI fan-out probe + distribution A/B =="
+echo "== [6/8] ICI fan-out probe + distribution A/B =="
 # Real remote-DMA numbers for the device-side distribution tier
 # (ddl_tpu/parallel/ici.py): per-hop bytes/s from the kernel probe,
 # then the ici-vs-xla A/B with link utilization against the per-link
@@ -88,7 +88,7 @@ DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_ici.py \
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici timeout 1200 python bench.py \
   2> "$ART/bench-ici-$STAMP.err" | tee "$ART/bench-ici-$STAMP.json"
 
-echo "== [7/7] distributed-optimizer probe + A/B =="
+echo "== [7/8] distributed-optimizer probe + A/B =="
 # The zero1/int8 measurement the ISSUE-8 artifact needs on real HBM:
 # state bytes/replica from placed shardings, the int8 gather leg on
 # real ICI, loss parity re-asserted on-chip.  Then the train_big MFU
@@ -103,5 +103,27 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big \
   DDL_TPU_TRAIN_OPTIMIZER_SHARDING=zero1 timeout 3000 python bench.py \
   2> "$ART/bench-big-zero1-$STAMP.err" \
   | tee "$ART/bench-big-zero1-$STAMP.json"
+
+echo "== [8/8] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
+# The fused compute/ingest step measured with REAL DMAs: (a) the
+# train-mode fit_stream leg carries the fused-vs-unfused A/B (on TPU
+# the unfused leg exposes the genuine H2D + ICI fan-out latency — no
+# simulated wire), targeting fused pipeline_overhead <= 0.02 with
+# fused_windows > 0 and slots_in_flight reaching 2 (both landing slots
+# genuinely in flight); (b) the stream re-measure with the fused
+# protocol default-on, targeting bandwidth_utilization >= 0.90 with
+# stall_fraction ~0 — the 0.8384 BENCH_TPU_r05 headline predates the
+# fused step, and closing that gap is exactly what this PR's overlap
+# exists to do (compounds ROADMAP item 5a).  DDL_TPU_FUSED=0 re-runs
+# the same legs under the synchronous discipline if the A/B needs a
+# whole-artifact baseline.
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=train timeout 3000 python bench.py \
+  2> "$ART/bench-fused-fit-$STAMP.err" \
+  | tee "$ART/bench-fused-fit-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=stream \
+  DDL_BENCH_STREAM_MIB=128 DDL_BENCH_LOOKAHEAD=2 DDL_BENCH_NSLOTS=3 \
+  timeout 1200 python bench.py \
+  2> "$ART/bench-fused-stream-$STAMP.err" \
+  | tee "$ART/bench-fused-stream-$STAMP.json"
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="

@@ -5,6 +5,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     cluster_loops,
     concurrency,
     device_path,
+    fused_step,
     ingest_path,
     jax_hazards,
     producer_fill,

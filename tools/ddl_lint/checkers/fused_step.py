@@ -1,0 +1,125 @@
+"""Fused-step hazards: host syncs inside the fused compute/ingest loop.
+
+The fused step's whole contract (``ddl_tpu/trainer.py`` +
+``ddl_tpu/parallel/ici.py``) is that the host thread NEVER waits on the
+device between dispatching scan N and acquiring window N+1 — that gap
+is where the entire data plane hides.  One stray
+``jax.block_until_ready``, ``jax.device_get``, ``float(device_value)``
+or ``.item()`` in the loop silently re-serializes ingest behind compute
+(the r5 regression measured it at 10-12% of step time) while every test
+still passes.  This checker makes the sync a lint failure instead of a
+throughput regression hunted in bench trajectories.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import dotted_name
+
+
+@register
+class FusedStepHostSync(Checker):
+    """DDL020: no host syncs in fused compute/ingest step functions.
+
+    Functions named in ``[tool.ddl_lint] fused_step_functions`` (bare
+    names or ``Class.method``) form the fused step's hot path: every
+    dispatch in them must stay asynchronous.  Inside them, flag:
+
+    - ``block_until_ready`` in any spelling — ``jax.block_until_ready
+      (x)`` or the method form ``x.block_until_ready()`` — an explicit
+      host wait,
+    - ``jax.device_get(...)`` (any attribute spelling) — a blocking
+      D2H fetch,
+    - ``float(f(...))`` — a scalar read-back of a computed value; on a
+      device array this synchronizes the whole dispatch queue up to it.
+      Scoped to CALL arguments (``float(losses.mean())``) because that
+      is the shape every device scalar read takes, while ``float`` of a
+      plain attribute/name (``float(plan.wire_bytes)``) is host
+      arithmetic the fused loop legitimately does,
+    - ``.item()`` method calls — the scalar spelling of the same sync,
+    - ``fanout_wait(..., sync=True)`` (keyword or positional) — the
+      fused path's OWN host-sync spelling: ``sync=True`` is a
+      ``block_until_ready`` inside the wait half, reserved for the
+      once-per-geometry bring-up validation.
+
+    Non-blocking readiness probes (``is_ready()``) stay clean: the
+    fused loop is REQUIRED to observe progress without waiting for it.
+    Escape hatch: ``# ddl-lint: disable=DDL020`` with a rationale (the
+    one shipped pragma is the distributor's once-per-geometry bring-up
+    validation sync; the trainer's deferred-by-one-window loss
+    read-back needs none — ``float(pending)`` rides the plain-name
+    carve-out by design).
+    """
+
+    code = "DDL020"
+    summary = "host sync inside a fused compute/ingest step function"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_hot(node):
+            self._check_body(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_hot(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "fused_step_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_body(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if node is fn or not isinstance(node, ast.Call):
+                continue
+            # Nested defs stay in scope on purpose: a closure built in
+            # the fused loop runs at the same per-window cadence.
+            hit = self._classify(node)
+            if hit:
+                self.report(
+                    node,
+                    f"{hit} in fused step function "
+                    f"{fn.name}();"  # type: ignore[attr-defined]
+                    " the data plane hides under the step only while"
+                    " the host never waits — defer the sync out of the"
+                    " loop or pragma-disable with a rationale",
+                )
+
+    def _classify(self, node: ast.Call) -> Optional[str]:
+        dotted = dotted_name(node.func) or ""
+        seg = dotted.rsplit(".", 1)[-1]
+        # Any spelling: jax.block_until_ready(x) or x.block_until_ready().
+        if seg == "block_until_ready":
+            return f"{dotted}(...)"
+        if seg == "device_get":
+            return f"{dotted}(...)"
+        # The scalar read-back spellings.  float() on a literal (or an
+        # empty call) is plain arithmetic, not a device sync.
+        if seg == "item" and "." in dotted:
+            return f"{dotted}()"
+        if dotted == "float" and node.args and isinstance(
+            node.args[0], ast.Call
+        ):
+            return "float(...) scalar read-back"
+        # The fused path's own sync spelling: fanout_wait(t, sync=True)
+        # wraps a block_until_ready.  A falsy/absent sync (the
+        # steady-state data-dependence wait) stays clean; a sync the
+        # checker cannot prove falsy (a variable) is flagged — the
+        # steady-state call site simply omits the kwarg.
+        if seg == "fanout_wait":
+            sync = None
+            if len(node.args) >= 2:
+                sync = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "sync":
+                    sync = kw.value
+            if sync is not None and not (
+                isinstance(sync, ast.Constant) and not sync.value
+            ):
+                return f"{dotted}(sync=...) forced host wait"
+        return None

@@ -44,6 +44,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL017",  # train-step jax.jit without donate_argnums/donate_argnames
     "DDL018",  # cluster loop with no deadline or lease-expiry check
     "DDL019",  # blocking wait inside a per-tenant serve loop
+    "DDL020",  # host sync inside a fused compute/ingest step function
 )
 
 
@@ -141,6 +142,20 @@ class LintConfig:
             "Autoscaler.step",
             "Autoscaler._run",
             "AdmissionController.report",
+        ]
+    )
+    #: Fused compute/ingest step functions (bare name or
+    #: ``Class.method``): the host must never wait on the device inside
+    #: them — a stray ``block_until_ready``/``device_get``/
+    #: ``float(array)``/``.item()`` re-serializes the data plane behind
+    #: compute (DDL020).
+    fused_step_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "Trainer._fused_stream_loop",
+            "DistributedDataLoader.gate_release_on",
+            "DistributedDataLoader._sweep_release_backlog",
+            "IciDistributor._distribute_planned",
+            "IciDistributor._track_in_flight",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -314,6 +329,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.serve_loop_functions = str_list(
         "serve_loop_functions", cfg.serve_loop_functions
+    )
+    cfg.fused_step_functions = str_list(
+        "fused_step_functions", cfg.fused_step_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
