@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -98,3 +98,21 @@ chaos:
 # the 4B fits-only-with-zero1 accounting test).
 opt-test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_optimizer.py -q
+
+# Data-plane wire-format A/B (raw vs int8 vs codec exchange wire over a
+# simulated constrained link; docs/PERF_NOTES.md "Wire format").
+# Lossless byte identity + int8 loss parity asserted in the artifact;
+# winner is the headline.
+wire-bench:
+	DDL_BENCH_MODE=wire JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Per-dtype/per-codec encode/decode bytes/s + compression ratios on
+# real shard data, break-even link speeds, and the analytic ICI wire
+# pricing — the mirror of probe_ici/probe_opt for the wire tier.
+wire-dryrun:
+	JAX_PLATFORMS=cpu $(PY) tools/probe_wire.py
+
+# Wire-format suite alone (codec/quantizer units, trailer roundtrip,
+# slot/exchange/ICI wire paths, the wire chaos rows).
+wire-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_wire.py -q

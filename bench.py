@@ -1374,6 +1374,222 @@ def _attn_sweep(seqs=(2048, 4096, 8192)):
 # -- shard-cache cold/warm A/B ------------------------------------------------
 
 
+class _ThrottledRendezvous:
+    """The ThrottledBackend pattern applied to the exchange wire: a
+    Rendezvous wrapper whose ``put`` pays ``nbytes / link_bytes_per_sec``
+    of simulated link time — so the wire-format A/B measures what a
+    CONSTRAINED link (DCN between hosts, a shared NIC) actually sees:
+    fewer bytes = faster rounds.  Take/discard/retire delegate."""
+
+    span = "thread"
+
+    def __init__(self, inner, link_bytes_per_sec: float):
+        self.inner = inner
+        self.link = float(link_bytes_per_sec)
+
+    def put(self, key, rows):
+        if self.link > 0:
+            time.sleep(rows.nbytes / self.link)
+        self.inner.put(key, rows)
+
+    def take(self, *a, **kw):
+        return self.inner.take(*a, **kw)
+
+    def discard(self, key):
+        self.inner.discard(key)
+
+    def retire(self, key):
+        self.inner.retire(key)
+
+
+def _run_wire_ab() -> dict:
+    """Raw vs quantized vs compressed exchange wire over a throttled
+    link (ISSUE 13, ROADMAP item 3).
+
+    Two simulated instances run the REAL ``ThreadExchangeShuffler``
+    exchange (the DCN shuffle wire) over a :class:`_ThrottledRendezvous`
+    whose put pays simulated link time per byte — the ThrottledBackend
+    pattern.  Three legs share one schedule: ``raw`` (fp32 lanes),
+    ``int8`` (blockwise-quantized envelopes), and the best available
+    lossless codec (compressible token-like float data, so compression
+    has something to find).  Legs run INTERLEAVED best-of-reps; the
+    winner is the headline under the never-slower invariant.
+
+    Honesty gates baked into the block (bench_smoke enforces):
+    the lossless leg's exchanged windows are byte-identical to raw's;
+    the lossy leg's loss curve (a deterministic linear-probe SGD on the
+    exchanged stream) passes the ``loss_parity`` gate with NONZERO
+    drift (zero drift would mean the wire silently wasn't engaged);
+    and the winner's ``wire_bytes`` is strictly below raw's at equal
+    ``payload_bytes``.
+
+    Geometry knobs: ``DDL_BENCH_WIRE_ROWS``/``COLS`` (window shape,
+    default 256x512), ``DDL_BENCH_WIRE_ROUNDS`` (exchange rounds per
+    rep, default 12), ``DDL_BENCH_WIRE_REPS`` (default 3),
+    ``DDL_BENCH_WIRE_LINK_MBPS`` (simulated link, default 96).
+    """
+    import threading
+
+    from ddl_tpu import wire as wire_mod
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.parallel.optimizer import loss_parity
+    from ddl_tpu.shuffle import Rendezvous, ThreadExchangeShuffler
+    from ddl_tpu.types import Topology
+
+    rows = int(os.environ.get("DDL_BENCH_WIRE_ROWS", "256"))
+    cols = int(os.environ.get("DDL_BENCH_WIRE_COLS", "512"))
+    rounds = int(os.environ.get("DDL_BENCH_WIRE_ROUNDS", "12"))
+    reps = int(os.environ.get("DDL_BENCH_WIRE_REPS", "3"))
+    link = float(os.environ.get("DDL_BENCH_WIRE_LINK_MBPS", "96")) * (1 << 20)
+    num_exchange = rows  # every row travels each round: worst-case wire
+    # Token-like compressible float data (small integer vocabulary):
+    # the lossless tier exists for exactly this shape of shard, and a
+    # codec leg over pure noise would only measure zlib's overhead.
+    base = [
+        (np.random.default_rng(100 + i).integers(0, 32, (rows, cols)))
+        .astype(np.float32)
+        for i in range(2)
+    ]
+
+    def probe_losses(streams) -> list:
+        """Deterministic linear-probe SGD over an exchanged window
+        stream — the loss-parity gate's curve (one per leg)."""
+        w = np.zeros(cols, np.float64)
+        y = np.sin(np.arange(rows)).astype(np.float64)
+        losses = []
+        for win in streams:
+            x = win.astype(np.float64)
+            pred = x @ w
+            losses.append(float(np.mean((pred - y) ** 2)))
+            grad = 2.0 * x.T @ (pred - y) / rows
+            w -= 1e-5 * grad
+        return losses
+
+    def run_leg(wire_dtype, codec):
+        """One rep of one leg: both instances exchange `rounds` times
+        over the throttled fabric; returns (samples/s, instance-0
+        stream, metrics)."""
+        rdv = _ThrottledRendezvous(Rendezvous(), link)
+        streams = [[], []]
+        metrics = [Metrics(), Metrics()]
+        errors = []
+
+        def worker(i):
+            try:
+                topo = Topology(
+                    n_instances=2, instance_idx=i, n_producers=1
+                )
+                sh = ThreadExchangeShuffler(
+                    topo, 1, num_exchange=num_exchange, rendezvous=rdv,
+                    seed=7, wire_dtype=wire_dtype, codec=codec,
+                    codec_level=1,  # wire compression wants speed
+                    exchange_timeout_s=60.0,
+                )
+                sh.metrics = metrics[i]
+                ary = base[i].copy()
+                for _ in range(rounds):
+                    sh.global_shuffle(ary)
+                    streams[i].append(ary.copy())
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        t0 = time.perf_counter()
+        ts = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("wire bench leg wedged (exchange stall)")
+        if errors:
+            raise errors[0]
+        dt = time.perf_counter() - t0
+        rate = 2 * rows * rounds / dt
+        return rate, streams[0], metrics[0]
+
+    codec = next(
+        (c for c in ("zstd", "lz4", "zlib")
+         if c in wire_mod.available_codecs()),
+        "zlib",
+    )
+    legs = {"raw": ("raw", None), "int8": ("int8", None),
+            codec: ("raw", codec)}
+    best: dict = {k: 0.0 for k in legs}
+    streams: dict = {}
+    wire_stats: dict = {}
+    for _ in range(reps):  # interleaved: box noise hits every leg alike
+        for name, (wd, cd) in legs.items():
+            rate, stream, m = run_leg(wd, cd)
+            if rate > best[name]:
+                best[name] = rate
+            streams[name] = stream
+            wire_stats[name] = m
+    # Per-INSTANCE lane bytes per leg (the wire_stats registries are
+    # instance 0's): num_exchange rows × cols × 4 bytes × rounds.
+    raw_payload = float(num_exchange * cols * 4 * rounds)
+    block: dict = {
+        "link_bytes_per_sec": link,
+        "rows": rows, "cols": cols, "rounds": rounds, "reps": reps,
+        "codec": codec, "codec_level": 1,
+        "legs": {},
+    }
+    for name in legs:
+        m = wire_stats[name]
+        enc = m.counter("wire.encoded_bytes")
+        pay = m.counter("wire.payload_bytes")
+        leg = {
+            "samples_per_sec": round(best[name], 1),
+            # The raw leg's fast path skips the envelope (and its
+            # accounting): its wire bytes ARE the lane bytes.
+            "wire_bytes": enc if enc else raw_payload,
+            "payload_bytes": pay if pay else raw_payload,
+        }
+        block["legs"][name] = leg
+    # Honesty gates: lossless byte identity, lossy parity (bounded AND
+    # nonzero drift), encoded wire strictly below raw.
+    block["byte_identical"] = all(
+        np.array_equal(a, b)
+        for a, b in zip(streams["raw"], streams[codec])
+    )
+    parity = loss_parity(
+        probe_losses(streams["raw"]), probe_losses(streams["int8"])
+    )
+    block["parity"] = bool(parity["parity"])
+    block["parity_drift"] = parity["max_rel_drift"]
+    block["legs"]["int8"]["parity"] = parity
+    winner = max(best, key=lambda k: best[k])
+    block["winner"] = winner
+    block["samples_per_sec"] = round(best[winner], 1)
+    # Never-slower is a MEASUREMENT, not an argmax identity: the
+    # selected winner must beat raw again in a fresh interleaved
+    # confirmation pair (comparing argmax(best) against max(best) would
+    # be a tautology that certifies nothing — bench_smoke asserts THIS
+    # flag, retried once against box noise).
+    if winner == "raw":
+        block["never_slower"] = True
+    else:
+        confirm = {}
+        for name in ("raw", winner):
+            wd, cd = legs[name]
+            rate, _, _ = run_leg(wd, cd)
+            confirm[name] = round(rate, 1)
+        block["confirm"] = confirm
+        block["never_slower"] = bool(confirm[winner] >= confirm["raw"])
+    block["wire_vs_raw"] = round(best[winner] / max(best["raw"], 1e-9), 3)
+    w_leg = block["legs"][winner]
+    block["winner_wire_below_raw"] = bool(
+        winner == "raw"
+        or (
+            w_leg["wire_bytes"] < block["legs"]["raw"]["wire_bytes"]
+            and w_leg["payload_bytes"]
+            == block["legs"]["raw"]["payload_bytes"]
+        )
+    )
+    return block
+
+
 def _run_cache_ab() -> dict:
     """Cold-vs-warm epoch A/B for the shard cache over a throttled backend.
 
@@ -2384,6 +2600,26 @@ def main() -> None:
             result["value"] = result["cache"]["warm_vs_cold"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["cache"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "wire":
+        # `make wire-bench`: raw vs quantized vs compressed exchange
+        # wire over a simulated constrained link (ISSUE 13), with the
+        # measured winner as the headline under the same never-slower
+        # invariant as every other competition; lossless byte identity
+        # + lossy loss-parity baked into the block (bench_smoke
+        # enforces).
+        result["metric"] = "wire_samples_per_sec"
+        result["unit"] = "samples/s"
+        try:
+            result["wire"] = _run_wire_ab()
+            result["value"] = result["wire"]["samples_per_sec"]
+            result["headline_config"] = result["wire"]["winner"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["wire"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

@@ -37,6 +37,7 @@ import threading
 from typing import Optional
 
 from ddl_tpu.cache.backends import (  # noqa: F401  (public re-exports)
+    CodecBackend,
     LocalBackend,
     StorageBackend,
     ThrottledBackend,
@@ -55,6 +56,7 @@ __all__ = [
     "CacheStore",
     "CacheWarmer",
     "KEY_SCHEMA_VERSION",
+    "CodecBackend",
     "LocalBackend",
     "StorageBackend",
     "ThrottledBackend",
@@ -93,6 +95,11 @@ def settings_from_env() -> dict:
         "spill_budget_bytes": int(
             os.environ.get("DDL_TPU_CACHE_SPILL_MB", "1024")
         ) << 20,
+        # Disk-tier codec (ddl_tpu.wire): spill entries stored
+        # compressed under the same byte budget.  Empty/"none" = off.
+        "codec": (
+            os.environ.get("DDL_TPU_CACHE_CODEC", "") or None
+        ),
     }
 
 

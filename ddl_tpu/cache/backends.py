@@ -141,6 +141,90 @@ class ThrottledBackend:
         return self.inner.fingerprint(path)
 
 
+#: Default codec inferred per file suffix by :class:`CodecBackend`.
+CODEC_SUFFIXES = {".zz": "zlib", ".gz": "zlib", ".zst": "zstd", ".lz4": "lz4"}
+
+
+class CodecBackend:
+    """A backend wrapper that decodes codec-compressed shard files on
+    the producer fill path (``ddl_tpu.wire`` lossless tier).
+
+    ``open(path)`` reads the inner backend's bytes and, when the path
+    carries a known codec suffix (``shard_000.npy.zz`` → zlib) or
+    ``codec=`` forces one, returns the DECODED bytes as a seekable
+    stream — so every shard reader (``np.load``, the tar walker, the
+    TFRecord iterator) consumes compressed shards transparently, and
+    the decode happens exactly once per fetch, before the write-once
+    fill (never per row).  The decode is bounded (``max_output``) and a
+    failure raises :class:`BackendFetchError` — deliberately the
+    TRANSIENT type, so a torn partial object from a flaky remote store
+    rides :func:`open_with_retry`'s existing bounded retry/backoff
+    ladder and only a *persistent* decode failure escalates to
+    :class:`IntegrityError` (the ``wire.decode`` chaos site fires per
+    attempt, so ``DECODE_FAIL`` exercises exactly that ladder).
+
+    ``fingerprint`` folds the codec tag next to the inner fingerprint:
+    a shard recompressed under a different codec can never alias its
+    cached decode.  Picklable (PROCESS-mode producers ship backends by
+    pickle): carries only names and bounds.
+    """
+
+    name = "codec"
+
+    def __init__(
+        self,
+        inner: Optional[StorageBackend] = None,
+        codec: Optional[str] = None,
+        max_output: int = 1 << 31,
+    ):
+        self.inner = inner or LocalBackend()
+        self.codec = codec
+        self.max_output = int(max_output)
+        if codec:
+            from ddl_tpu import wire
+
+            wire.get_codec(codec)  # fail at construction, not first shard
+
+    def _codec_for(self, path: str) -> Optional[str]:
+        if self.codec:
+            return self.codec
+        for suffix, name in CODEC_SUFFIXES.items():
+            if path.endswith(suffix):
+                return name
+        return None
+
+    def open(self, path: str) -> BinaryIO:
+        import io
+
+        from ddl_tpu import wire
+        from ddl_tpu.exceptions import DecodeError
+
+        name = self._codec_for(path)
+        if name is None:
+            return self.inner.open(path)
+        with self.inner.open(path) as f:
+            raw = f.read()
+        try:
+            fault_point("wire.decode")
+            return io.BytesIO(
+                wire.get_codec(name).decode_bytes(
+                    raw, max_output=self.max_output
+                )
+            )
+        except DecodeError as e:
+            # The TRANSIENT type on purpose: open_with_retry's bounded
+            # retry re-fetches (a torn partial object heals); only a
+            # persistent failure escalates to IntegrityError there.
+            raise BackendFetchError(
+                f"codec decode of {path!r} failed ({name}): {e}"
+            ) from e
+
+    def fingerprint(self, path: str) -> str:
+        name = self._codec_for(path)
+        inner = self.inner.fingerprint(path)
+        return f"{inner}:codec={name}" if name else inner
+
+
 def open_with_retry(
     backend: StorageBackend,
     path: str,

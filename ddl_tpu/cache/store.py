@@ -122,11 +122,27 @@ class CacheStore:
         spill_dir: Optional[str] = None,
         spill_budget_bytes: int = 1 << 30,
         metrics: Optional[Metrics] = None,
+        codec: Optional[str] = None,
+        codec_level: int = 6,
     ):
         self.ram_budget_bytes = int(ram_budget_bytes)
         self.spill_dir = os.path.abspath(spill_dir) if spill_dir else None
         self.spill_budget_bytes = int(spill_budget_bytes)
         self.metrics = metrics or default_metrics()
+        # Lossless codec for DISK-tier entries (ddl_tpu.wire): spill
+        # files store the codec-compressed payload (the crc trailer
+        # covers the stored bytes, so verification is unchanged), decoded
+        # on promote — the same spill budget then holds ~ratio× more
+        # shards.  The RAM tier stays decoded: a hit must stay a view.
+        # Validated here (fail at construction, not first spill); a
+        # decode failure on read rides the existing quarantine+refetch
+        # rung.
+        self.codec = codec if codec and codec != "none" else None
+        if self.codec:
+            from ddl_tpu import wire as _wire
+
+            _wire.get_codec(self.codec)
+        self.codec_level = int(codec_level)
         # Two locks so a pure RAM-tier hit never waits on disk I/O:
         # _lock guards the LRU bookkeeping only; _spill_lock serializes
         # disk-tier writes/trims/quarantines and their accounting.
@@ -353,14 +369,21 @@ class CacheStore:
         path = self._spill_path(digest)
         if os.path.exists(path):
             return  # content-addressed: same digest == same bytes
-        meta = json.dumps(
-            {
-                "schema": KEY_SCHEMA_VERSION,
-                "dtype": arr.dtype.str,
-                "shape": list(arr.shape),
-            }
-        ).encode()
+        meta_d = {
+            "schema": KEY_SCHEMA_VERSION,
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+        }
         payload = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+        if self.codec:
+            from ddl_tpu import wire as _wire
+
+            packed = _wire.get_codec(self.codec).encode_bytes(
+                payload.tobytes(), level=self.codec_level
+            )
+            payload = np.frombuffer(packed, np.uint8)
+            meta_d["codec"] = self.codec
+        meta = json.dumps(meta_d).encode()
         off = _META_LEN_BYTES + len(meta)
         total = off + payload.nbytes + integrity.HEADER_BYTES
         if total > self.spill_budget_bytes:
@@ -473,8 +496,29 @@ class CacheStore:
             )
             if err:
                 raise ValueError(err)
+            stored = raw[off : off + payload_bytes]
+            if meta.get("codec"):
+                # Compressed entry: the crc above verified the STORED
+                # bytes; a codec failure past it (truncated history,
+                # foreign codec) quarantines + refetches like any
+                # corrupt entry.  Decode is bounded by the shape the
+                # meta declares.
+                from ddl_tpu import wire as _wire
+                from ddl_tpu.exceptions import DecodeError
+
+                dtype = np.dtype(meta["dtype"])
+                bound = int(np.prod(meta["shape"])) * dtype.itemsize
+                try:
+                    stored = np.frombuffer(
+                        _wire.get_codec(meta["codec"]).decode_bytes(
+                            stored.tobytes(), max_output=bound
+                        ),
+                        np.uint8,
+                    )
+                except DecodeError as e:
+                    raise ValueError(f"codec decode failed: {e}") from e
             arr = (
-                raw[off : off + payload_bytes]
+                stored
                 .view(np.dtype(meta["dtype"]))
                 .reshape(meta["shape"])
             )

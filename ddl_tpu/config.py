@@ -61,6 +61,17 @@ class LoaderConfig:
     cache_spill_dir: Optional[str] = None
     cache_spill_mb: int = 1024
     cache_warm: bool = True
+    # Wire format (ddl_tpu.wire; docs/PERF_NOTES.md "Wire format").
+    # ``wire_dtype``: "" = no opinion (the per-reader capability
+    # decides), "raw" = kill switch, "bf16"/"int8" = force the lossy
+    # tier (A/B runs; licensed by the loss-parity gate).  ``wire_codec``:
+    # "" / "none" = off, else a lossless codec name ("zlib" always;
+    # "zstd"/"lz4" where the host has the library) for the shuffle
+    # exchange wire and compressed shard/cache reads.  Mirrored into
+    # DDL_TPU_WIRE_DTYPE / DDL_TPU_WIRE_CODEC ahead of producer spawn
+    # (ddl_tpu.env._export_wire_knobs).
+    wire_dtype: str = ""
+    wire_codec: str = ""
 
     _ENV_PREFIX = "DDL_TPU_"
 

@@ -201,6 +201,13 @@ class DistributedDataLoader:
         # rows are not locally regenerable) — with shuffle active a
         # corrupt slot escalates straight to IntegrityError.
         self._integrity = all(getattr(r, "integrity", False) for r in replies)
+        # Wire format per producer (ddl_tpu.wire): slots from a
+        # wire-encoded producer carry the bf16/int8 payload + trailer
+        # scales; the consumer edge decodes them back to the logical
+        # shape/dtype the handshake reported (``_slot_array``).
+        self._wire_dtypes = [
+            getattr(r, "wire_dtype", "raw") or "raw" for r in replies
+        ]
         self._shuffle_fraction = global_shuffle_fraction_exchange
         self._max_replays = int(os.environ.get("DDL_TPU_MAX_REPLAYS", "2"))
         # Per-target count of DISCARDED ring commits (quarantined slots +
@@ -894,14 +901,64 @@ class DistributedDataLoader:
         self._target = self._next_target(self._target)
 
     def _slot_array(self, target: int, slot: int) -> np.ndarray:
-        """Zero-copy window view of an acquired slot, shaped for ``target``."""
+        """Window array of an acquired slot, shaped for ``target``.
+
+        Raw producers: a zero-copy view of the slot payload.  Wire-
+        encoded producers (``ddl_tpu.wire``): the slot holds the
+        bf16/int8 payload + trailer scales; this is the CONSUMER EDGE
+        decode — a fresh array per acquire (never a shared scratch:
+        lookahead holds several of one target's windows live at once),
+        after which nothing downstream reads the slot.  A decode
+        failure (the ``wire.decode`` chaos site's ``DECODE_FAIL``, or
+        real bit rot the CRC somehow missed) retries once, then
+        escalates to :class:`IntegrityError` — by then the bytes are
+        provably undecodable, the same terminal rung a persistent
+        backend failure reaches.
+        """
         ring = self.connection.rings[target]
         nbytes = ring.slot_payload(slot)
-        return (
-            ring.slot_view(slot)[:nbytes]
-            .view(self.dtypes[target])
-            .reshape(self.shapes[target])
+        if self._wire_dtypes[target] == "raw":
+            return (
+                ring.slot_view(slot)[:nbytes]
+                .view(self.dtypes[target])
+                .reshape(self.shapes[target])
+            )
+        from ddl_tpu import wire
+        from ddl_tpu.exceptions import DecodeError
+        from ddl_tpu.faults import fault_point
+
+        view = ring.slot_view(slot)
+        hdr = integrity.read_header(view, nbytes)
+        scales = (
+            integrity.read_scales(view, nbytes, hdr.scale_bytes)
+            if hdr.scale_bytes
+            else None
         )
+        for attempt in (1, 2):
+            try:
+                fault_point("wire.decode", view=view[:nbytes])
+                dec = wire.decode_window(
+                    np.array(view[:nbytes]), scales,
+                    self.shapes[target], self.dtypes[target],
+                    hdr.wire_dtype,
+                )
+                break
+            except DecodeError as e:
+                self.metrics.incr("wire.decode_fails")
+                if attempt == 2:
+                    raise IntegrityError(
+                        f"window from producer {target + 1} undecodable "
+                        f"after retry ({hdr.wire_dtype} wire): {e}"
+                    ) from e
+        self.metrics.incr("wire.decoded_windows")
+        # The wire accounting pair (encoded bytes that traveled the
+        # slot vs the logical raw bytes served) — counted HERE, the one
+        # registry every run mode shares.
+        self.metrics.incr(
+            "wire.encoded_bytes", float(nbytes + hdr.scale_bytes)
+        )
+        self.metrics.incr("wire.payload_bytes", float(dec.nbytes))
+        return dec
 
     # -- deferred (transfer-gated) slot release ----------------------------
 

@@ -81,8 +81,8 @@ def _abort_sentinel() -> str:
 # ``_commit_window`` (per-window hot path) stays quiet.
 @for_all_methods(
     with_logging,
-    exclude=("_commit_window", "_stamp_and_commit", "_slot_array",
-             "_poll_control"),
+    exclude=("_commit_window", "_stamp_and_commit", "_encode_and_commit",
+             "_slot_array", "_poll_control"),
 )
 class DataPusher:
     """One producer worker: handshake, then fill windows until shutdown.
@@ -153,6 +153,38 @@ class DataPusher:
                 f"{init_ret.nData}",
             )
         self.window_nbytes = int(np.prod(self.shape)) * self.dtype.itemsize
+        # Wire format (ddl_tpu.wire): the reader's per-capability
+        # wire_dtype (env-overridable) selects what BYTES the slot
+        # commit carries — raw, or the blockwise bf16/int8 encoding
+        # with scales in the integrity trailer extension.  Lossy wire
+        # needs the trailer (scales have nowhere else to travel) and a
+        # float window; both are validated at handshake, not mid-run.
+        from ddl_tpu import wire
+
+        self.wire_dtype = wire.resolve_wire_dtype(
+            getattr(meta.data_producer_function, "wire_dtype", "raw")
+        )
+        if self.wire_dtype != "raw":
+            if not self._integrity:
+                raise DoesNotMatchError(
+                    self.wire_dtype,
+                    "lossy wire_dtype needs DDL_TPU_INTEGRITY on (the "
+                    "quantization scales travel in the slot trailer "
+                    "extension next to the CRC)",
+                )
+            if not wire.lossy_supported(self.dtype):
+                raise DoesNotMatchError(
+                    self.dtype.name,
+                    f"lossy wire_dtype {self.wire_dtype!r} needs a float "
+                    "window dtype (use the lossless codec tier for "
+                    "token/image shards)",
+                )
+        self._enc_nbytes = wire.encoded_nbytes(
+            self.shape, self.dtype, self.wire_dtype
+        )
+        self._scale_nbytes = wire.scale_bytes_for(
+            self.shape, self.wire_dtype
+        )
         # Fill discipline: ``inplace_fill = True`` on the producer
         # function FORCES slot-view fills (the original contract);
         # ``supports_inplace_fill = True`` advertises write-once
@@ -265,6 +297,19 @@ class DataPusher:
                     )
                 self.callbacks.append(self.shuffler)
 
+        # Wire-encoded commits need a RAW source array distinct from the
+        # slot (the encode reads the float window and writes the int8/
+        # bf16 payload — encoding a slot in place would destroy its own
+        # input), so the lossy wire keeps the private-array fill: auto
+        # inplace is silently skipped (the shuffle precedent), forced
+        # inplace is a contract conflict and fails at handshake.
+        if self.wire_dtype != "raw" and self._forced_inplace:
+            raise DoesNotMatchError(
+                type(meta.data_producer_function).__name__,
+                "inplace_fill producers cannot use a lossy wire_dtype "
+                "(the encode needs the raw window as its source; use "
+                "the default copy fill or wire_dtype='raw')",
+            )
         # Auto inplace (write-once producers): a shuffler needs my_ary to
         # persist across iterations (the exchange mutates it between
         # fills), so capability-advertising producers silently keep the
@@ -274,6 +319,7 @@ class DataPusher:
             self._auto_inplace
             and not self.inplace_fill
             and self.shuffler is None
+            and self.wire_dtype == "raw"
             and inplace_enabled()
         ):
             self.inplace_fill = True
@@ -282,10 +328,31 @@ class DataPusher:
             self.my_ary = np.zeros(self.shape, dtype=self.dtype)
 
         # Integrity slots are one trailer header larger than the payload;
-        # geometry (shape/splits/payload) is untouched.
+        # geometry (shape/splits/payload) is untouched.  Wire-encoded
+        # commits use strictly LESS of the slot (encoded payload +
+        # header + scales < raw payload for every supported float
+        # dtype), so slots stay raw-sized: a replayed/rejoined producer
+        # never depends on the wire setting for its ring geometry.
         slot_bytes = self.window_nbytes + (
             integrity.HEADER_BYTES if self._integrity else 0
         )
+        if self.wire_dtype != "raw" and (
+            self._enc_nbytes + integrity.HEADER_BYTES + self._scale_nbytes
+            > slot_bytes
+        ):
+            # Degenerate geometries CAN overflow: int8 with 1 value per
+            # row pays a 4-byte scale per 1-byte payload (scales are
+            # per-row-block), so "encoded < raw" does not hold for
+            # every shape — refuse at handshake like every other
+            # invalid wire config, never mid-run.
+            raise DoesNotMatchError(
+                self.shape,
+                f"wire_dtype {self.wire_dtype!r} does not shrink this "
+                f"window geometry (encoded {self._enc_nbytes} + trailer "
+                f"{integrity.HEADER_BYTES + self._scale_nbytes} exceeds "
+                f"the {slot_bytes}-byte slot); use wire_dtype='raw' for "
+                "windows this narrow",
+            )
         if rejoin_ring is not None:
             self.ring = connection.attach_ring(rejoin_ring)
             if self._integrity and self.ring.slot_bytes < slot_bytes:
@@ -331,6 +398,7 @@ class DataPusher:
                 batches_per_window=self.batches_per_window,
                 dtype=self.dtype.name,
                 integrity=self._integrity,
+                wire_dtype=self.wire_dtype,
             )
         )
 
@@ -349,9 +417,14 @@ class DataPusher:
             committed = int(self.ring.stats()["committed"])
             done = committed
             if self._integrity and committed:
+                # Header offset follows the wire format: encoded slots
+                # commit the ENCODED payload size, and the encoding is a
+                # pure function of (geometry, wire_dtype) the respawn
+                # re-derives — env drift across a respawn already fails
+                # the integrity-headroom check above.
                 hdr = integrity.read_header(
                     self.ring.slot_view((committed - 1) % self.ring.nslots),
-                    self.window_nbytes,
+                    self._enc_nbytes,
                 )
                 if hdr.valid_magic:
                     done = hdr.seq + 1
@@ -369,10 +442,27 @@ class DataPusher:
                     # here — shuffle + inplace_fill is rejected above,
                     # and slots are only ever overwritten by this
                     # producer), so restore the full state from it.
-                    np.copyto(
-                        self.my_ary,
-                        self._slot_array((committed - 1) % self.ring.nslots),
-                    )
+                    last = (committed - 1) % self.ring.nslots
+                    if self.wire_dtype != "raw":
+                        # Encoded slot: the predecessor's exact my_ary is
+                        # not recoverable (the wire is lossy); restore
+                        # the DECODED window — the same values the
+                        # consumer served, so the exchange schedule
+                        # stays coherent at wire precision.
+                        from ddl_tpu import wire
+
+                        view = self.ring.slot_view(last)
+                        hdr = integrity.read_header(view, self._enc_nbytes)
+                        wire.decode_window(
+                            view[: self._enc_nbytes],
+                            integrity.read_scales(
+                                view, self._enc_nbytes, hdr.scale_bytes
+                            ) if hdr.scale_bytes else None,
+                            self.shape, self.dtype, self.wire_dtype,
+                            out=self.my_ary,
+                        )
+                    else:
+                        np.copyto(self.my_ary, self._slot_array(last))
             if self.shuffler is not None:
                 # Re-enter the exchange schedule at the committed round:
                 # the permutation is a pure function of (seed, round),
@@ -426,6 +516,47 @@ class DataPusher:
         )
         self.ring.commit(slot, self.window_nbytes)
 
+    def _encode_and_commit(self, slot: int) -> None:
+        """Wire-encoded commit (``ddl_tpu.wire``): the slot carries the
+        blockwise bf16/int8 payload, the scales travel in the trailer
+        extension next to the CRC, and the CRC covers the ENCODED bytes
+        + scales — so the consumer's drain-time verify catches wire
+        corruption exactly like raw corruption, and quarantine-and-
+        replay re-encodes from the deterministic raw stream.  The
+        ``wire.encode`` chaos site fires against the encoded payload
+        AFTER the header is stamped (the ``producer.commit`` timing),
+        so flipped wire bytes mismatch the committed CRC.
+        """
+        from ddl_tpu import wire
+
+        view = self.ring.slot_view(slot)
+        payload, scales = wire.encode_window(self.my_ary, self.wire_dtype)
+        enc = self._enc_nbytes
+        view[:enc] = payload
+        if scales is not None:
+            integrity.write_scales(view, enc, scales)
+        # ONE fold implementation for both sides of the contract: the
+        # drain-time verify recomputes exactly integrity.wire_crc.
+        crc = integrity.wire_crc(view, enc, self._scale_nbytes)
+        integrity.write_header(
+            view, enc,
+            seq=self._iteration,
+            producer_idx=self.producer_idx,
+            crc=crc,
+            wire_code=wire.WIRE_CODES[self.wire_dtype],
+            scale_bytes=self._scale_nbytes,
+        )
+        fault_point(
+            "wire.encode",
+            producer_idx=self.producer_idx,
+            view=view[:enc],
+        )
+        # Byte accounting lands at the CONSUMER edge's decode (the one
+        # registry every mode shares — PROCESS producers' registries
+        # never cross the spawn boundary, and THREAD's shared default
+        # registry would double-count if both sides incremented).
+        self.ring.commit(slot, enc)
+
     def _commit_window(self) -> None:
         """Publish the filled window and stage the next fill target."""
         if self.inplace_fill:
@@ -433,6 +564,9 @@ class DataPusher:
             # next free slot for the coming refill.
             assert self._fill_slot is not None
             self._stamp_and_commit(self._fill_slot)
+        elif self.wire_dtype != "raw":
+            slot = self.ring.acquire_fill()  # raises ShutdownRequested on stop
+            self._encode_and_commit(slot)
         else:
             slot = self.ring.acquire_fill()  # raises ShutdownRequested on stop
             np.copyto(self._slot_array(slot), self.my_ary)

@@ -80,6 +80,17 @@ class ProducerFunctionSkeleton(abc.ABC):
     inplace_fill: bool = False
     supports_inplace_fill: bool = False
 
+    #: Wire-format capability (``ddl_tpu.wire``, opt-in per reader):
+    #: ``"raw"`` (default) commits windows at their storage dtype;
+    #: ``"bf16"`` / ``"int8"`` license the pusher to commit the
+    #: blockwise-encoded wire payload instead (scales in the integrity
+    #: trailer extension, decoded at the consumer edge) — valid only
+    #: for float windows, and a LOSSY statement: set it on readers
+    #: whose data tolerates the quantization (the loss-parity gate is
+    #: the license — docs/PERF_NOTES.md "Wire format").  The
+    #: ``DDL_TPU_WIRE_DTYPE`` env overrides either way.
+    wire_dtype: str = "raw"
+
     @abc.abstractmethod
     def on_init(self, **kwargs: Any) -> DataProducerOnInitReturn:
         raise NotImplementedError

@@ -313,6 +313,35 @@ def _export_cluster_knobs(config: Any) -> None:
             _exported_cluster_vars.discard(var)
 
 
+#: Wire env vars THIS process exported from a config (never user-set
+#: ones) — the _export_cluster_knobs precedent.
+_exported_wire_vars: set = set()
+
+
+def _export_wire_knobs(config: Any) -> None:
+    """Mirror a LoaderConfig's wire-format fields into the
+    ``DDL_TPU_WIRE_DTYPE``/``DDL_TPU_WIRE_CODEC`` environment BEFORE
+    producers spawn (the ``_export_cache_knobs`` pattern): PROCESS/
+    MULTIHOST workers resolve their wire dtype from the environment
+    they inherit, and producer and consumer must agree on slot layout.
+    Empty-string fields state no opinion (the per-reader capability
+    decides): they leave USER-set environment untouched but clear this
+    process's own prior exports.
+    """
+    if config is None:
+        return
+    for var, value in (
+        ("DDL_TPU_WIRE_DTYPE", getattr(config, "wire_dtype", "")),
+        ("DDL_TPU_WIRE_CODEC", getattr(config, "wire_codec", "")),
+    ):
+        if value:
+            os.environ[var] = str(value)
+            _exported_wire_vars.add(var)
+        elif var in _exported_wire_vars:
+            os.environ.pop(var, None)
+            _exported_wire_vars.discard(var)
+
+
 class WorkerSet:
     """The spawned producer workers + consumer-side connection."""
 
@@ -492,6 +521,7 @@ def distributed_dataloader(
             topology = detect_topology(n_producers, mode, host_id, n_hosts)
             depth = nslots or int(os.environ.get("DDL_TPU_NSLOTS", "2"))
             _export_cache_knobs(config)
+            _export_wire_knobs(config)
             workers = WorkerSet(topology, depth, shuffler_factory)
             env = DDL_Env(
                 topology=topology, connection=workers.connection,

@@ -86,6 +86,20 @@ class BackendFetchError(TransportError):
     """
 
 
+class DecodeError(DDLError):
+    """A wire payload failed to decode (``ddl_tpu.wire``): a codec
+    raised, an envelope field was malformed, or the declared output
+    bound was exceeded.
+
+    Also the real type the ``DECODE_FAIL`` fault kind raises at the
+    ``wire.decode`` site, so chaos exercises the production ladder:
+    bounded retry, then the raw fallback for that wire path
+    (``wire.fallbacks``) — a shuffle round degrades to raw encoding, a
+    compressed shard read escalates to :class:`BackendFetchError` and
+    rides ``open_with_retry``'s existing retry/backoff discipline.
+    """
+
+
 class HostLostError(DDLError):
     """A whole host left the cluster view (lease expiry, declared loss,
     or the ``HOST_LOSS`` fault kind at ``cluster.heartbeat``).

@@ -79,6 +79,21 @@ Injection points shipped today (site — fault kinds that act there):
                           ``SCALE_DECISION_DELAY`` sleeps ``param``
                           seconds there — a slow control plane degrades
                           scale-up reaction time, never correctness
+``wire.encode``           wire-format encode sites (``ddl_tpu.wire``):
+                          after a producer's encoded slot commit is
+                          CRC-stamped, and inside ``pack_rows`` for the
+                          shuffle exchange — ``WIRE_CORRUPTION`` flips
+                          bytes in the ENCODED payload, so drain-time
+                          integrity (which verifies the quantized
+                          bytes) quarantines and replays exactly like
+                          raw corruption
+``wire.decode``           wire-format decode sites: the consumer edge's
+                          slot decode, ``unpack_rows`` (exchange), and
+                          ``CodecBackend.open`` — ``DECODE_FAIL``
+                          raises the real ``DecodeError``, exercising
+                          each path's ladder: bounded retry, then the
+                          raw fallback (``wire.fallbacks``) or the
+                          backend retry/refetch rung
 ========================  ====================================================
 """
 
@@ -122,6 +137,8 @@ class FaultKind(enum.Enum):
     HEARTBEAT_DROP = "heartbeat_drop"
     TENANT_BURST = "tenant_burst"
     SCALE_DECISION_DELAY = "scale_decision_delay"
+    WIRE_CORRUPTION = "wire_corruption"
+    DECODE_FAIL = "decode_fail"
 
 
 @dataclasses.dataclass
@@ -262,6 +279,7 @@ class FaultPlan:
         elif kind in (
             FaultKind.RING_CORRUPTION,
             FaultKind.CACHE_CORRUPTION,
+            FaultKind.WIRE_CORRUPTION,
         ):
             if view is None or len(view) == 0:
                 return  # site carries no mutable payload; nothing to flip
@@ -301,6 +319,14 @@ class FaultPlan:
                 f"tenant burst {where}",
                 burst_bytes=spec.param or (64 << 20),
             )
+        elif kind is FaultKind.DECODE_FAIL:
+            # The real type (the BACKEND_FETCH_FAIL pattern): every
+            # wire.decode site's production ladder — bounded retry,
+            # then raw fallback / backend refetch — is what the
+            # injection tests.
+            from ddl_tpu.exceptions import DecodeError
+
+            raise DecodeError(f"decode failure {where}")
         elif kind is FaultKind.SHUFFLE_PEER_LOSS:
             raise DDLError(f"shuffle peer loss {where}")
         else:  # pragma: no cover - FaultKind is closed above

@@ -577,6 +577,23 @@ def north_star_report(
         for k, v in m.prefixed("serve.stall.").items()
         if not k.endswith(".max")
     }
+    # Data-plane wire format (ddl_tpu.wire, ISSUE 13): bytes that
+    # actually traveled an encode-engaged wire (slot commits, exchange
+    # envelopes, the ICI fan-out) vs the logical raw bytes the same
+    # windows represent — the honest numerator/denominator pair for
+    # every "the wire got smaller" claim — plus the consumer-edge
+    # decode counter and the degradation-ladder counters.  SCOPE: like
+    # every producer.* counter, the EXCHANGE wire's ladder events are
+    # counted in the shuffler's own registry — consumer-visible in
+    # THREAD mode (shared default registry), per-worker-process in
+    # PROCESS mode (read them from the producer logs / the bench wire
+    # mode's own shuffler registries); the slot-path decode counters
+    # below are consumer-side and surface in every mode.
+    report["wire_encoded_bytes"] = m.counter("wire.encoded_bytes")
+    report["wire_payload_bytes"] = m.counter("wire.payload_bytes")
+    report["wire_decoded_windows"] = m.counter("wire.decoded_windows")
+    report["wire_decode_fails"] = m.counter("wire.decode_fails")
+    report["wire_fallbacks"] = m.counter("wire.fallbacks")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

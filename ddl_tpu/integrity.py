@@ -27,9 +27,23 @@ measured noise next to the slot memcpy it guards).  ``DDL_TPU_INTEGRITY=0``
 disables the whole layer: slots shrink back, commits and drains skip the
 checksum, and the loader serves exactly the PR 2 byte path.
 
-Header layout (little-endian, 24 used of 32 reserved bytes)::
+Header layout (little-endian, 32 of 32 reserved bytes used)::
 
-    u32 magic   u32 crc32(payload)   u64 seq   u32 producer_idx   u32 flags
+    u32 magic   u32 crc32(payload [+ scales])   u64 seq
+    u32 producer_idx   u32 flags   u32 wire_code   u32 scale_bytes
+
+The last two fields are the WIRE-FORMAT extension (``ddl_tpu.wire``):
+``wire_code`` names the payload's wire dtype (0 = raw — the value old
+headers carry implicitly, so pre-wire rings verify unchanged) and
+``scale_bytes`` sizes the blockwise-quantization scales that travel in
+the TRAILER EXTENSION, the region immediately past this header
+(slots for wire-encoded windows are committed with the *encoded*
+payload size, so header + scales always fit inside the raw-sized
+slot).  The CRC covers the encoded payload AND the scales — integrity
+verifies the *quantized* bytes, so corruption detection survives the
+dtype change: a flipped wire byte mismatches the committed CRC exactly
+like flipped raw bytes, and the quarantine-and-replay ladder runs
+unchanged.
 """
 
 from __future__ import annotations
@@ -45,8 +59,10 @@ import numpy as np
 HEADER_BYTES = 32
 
 _MAGIC = 0x44444C57  # "DDLW"
-_FMT = "<IIQII"
-_FMT_BYTES = struct.calcsize(_FMT)  # 24
+_FMT = "<IIQIIII"
+_FMT_BYTES = struct.calcsize(_FMT)  # 32 (wire_code + scale_bytes appended;
+# the first 24 bytes keep the pre-wire layout, so old headers parse with
+# wire_code == scale_bytes == 0 — i.e. raw)
 
 
 def integrity_enabled(override: Optional[bool] = None) -> bool:
@@ -61,6 +77,29 @@ def window_crc(payload: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(payload)) & 0xFFFFFFFF
 
 
+def wire_crc(slot_view: np.ndarray, payload_bytes: int,
+             scale_bytes: int) -> int:
+    """The committed CRC of a (possibly wire-encoded) slot: the payload
+    fold continued over the trailer-extension scales.
+
+    THE shared implementation for both sides of the contract — the
+    producer's encoded commit and :func:`verify_window`'s drain check
+    call this one function, so the fold order / region layout cannot
+    desynchronize between them.  ``scale_bytes == 0`` degrades to the
+    plain :func:`window_crc`.
+    """
+    crc = window_crc(slot_view[:payload_bytes])
+    if scale_bytes:
+        start = payload_bytes + HEADER_BYTES
+        crc = zlib.crc32(
+            np.ascontiguousarray(
+                slot_view[start : start + scale_bytes]
+            ),
+            crc,
+        ) & 0xFFFFFFFF
+    return crc
+
+
 @dataclasses.dataclass(frozen=True)
 class WindowHeader:
     magic: int
@@ -68,10 +107,22 @@ class WindowHeader:
     seq: int
     producer_idx: int
     flags: int
+    #: Wire-format extension (``ddl_tpu.wire``): the payload's wire
+    #: dtype code (0 = raw) and the byte length of the blockwise scales
+    #: stored in the trailer extension past this header.
+    wire_code: int = 0
+    scale_bytes: int = 0
 
     @property
     def valid_magic(self) -> bool:
         return self.magic == _MAGIC
+
+    @property
+    def wire_dtype(self) -> str:
+        """The payload's wire dtype name ("raw" for pre-wire headers)."""
+        from ddl_tpu import wire
+
+        return wire._CODE_TO_DTYPE.get(self.wire_code, "raw")
 
 
 def blob_seq(digest: str) -> int:
@@ -93,9 +144,20 @@ def write_header(
     seq: int,
     producer_idx: int,
     crc: int,
+    wire_code: int = 0,
+    scale_bytes: int = 0,
 ) -> None:
-    """Stamp the trailer header into ``slot_view`` past the payload."""
-    packed = struct.pack(_FMT, _MAGIC, crc, seq, producer_idx, 0)
+    """Stamp the trailer header into ``slot_view`` past the payload.
+
+    ``payload_bytes`` is the size of the bytes that actually travel —
+    the *encoded* size for wire-formatted windows.  ``wire_code`` /
+    ``scale_bytes`` describe the encoding (``ddl_tpu.wire``); the
+    scales themselves are written separately
+    (:func:`write_scales`), immediately past this header.
+    """
+    packed = struct.pack(
+        _FMT, _MAGIC, crc, seq, producer_idx, 0, wire_code, scale_bytes
+    )
     slot_view[payload_bytes : payload_bytes + _FMT_BYTES] = np.frombuffer(
         packed, dtype=np.uint8
     )
@@ -103,8 +165,37 @@ def write_header(
 
 def read_header(slot_view: np.ndarray, payload_bytes: int) -> WindowHeader:
     raw = bytes(slot_view[payload_bytes : payload_bytes + _FMT_BYTES])
-    magic, crc, seq, producer_idx, flags = struct.unpack(_FMT, raw)
-    return WindowHeader(magic, crc, seq, producer_idx, flags)
+    magic, crc, seq, producer_idx, flags, wire_code, scale_bytes = (
+        struct.unpack(_FMT, raw)
+    )
+    return WindowHeader(
+        magic, crc, seq, producer_idx, flags, wire_code, scale_bytes
+    )
+
+
+def write_scales(
+    slot_view: np.ndarray, payload_bytes: int, scales: np.ndarray
+) -> None:
+    """Write the blockwise-quantization scales into the trailer
+    EXTENSION — the region immediately past the 32-byte header.  The
+    caller stamps the matching ``scale_bytes`` via :func:`write_header`
+    and folds the scales into the committed CRC
+    (``crc32(scales, crc32(payload))`` — see :func:`verify_window`)."""
+    raw = np.ascontiguousarray(scales).view(np.uint8).reshape(-1)
+    start = payload_bytes + HEADER_BYTES
+    slot_view[start : start + raw.nbytes] = raw
+
+
+def read_scales(
+    slot_view: np.ndarray, payload_bytes: int, scale_bytes: int
+) -> np.ndarray:
+    """The trailer extension's scales as a flat fp32 array (a copy —
+    the slot may be released/overwritten while the decode is live)."""
+    start = payload_bytes + HEADER_BYTES
+    return (
+        np.array(slot_view[start : start + scale_bytes])
+        .view(np.float32)
+    )
 
 
 def verify_window(
@@ -130,10 +221,15 @@ def verify_window(
         )
     if hdr.seq != expect_seq:
         return f"window seq {hdr.seq}, expected {expect_seq} (drop/duplicate)"
-    got = window_crc(slot_view[:payload_bytes])
+    # The CRC covers the bytes that actually traveled: the (possibly
+    # wire-encoded) payload, then the trailer-extension scales — so
+    # corruption detection survives the dtype change (a flipped int8
+    # wire byte or scale byte mismatches exactly like a raw one).
+    got = wire_crc(slot_view, payload_bytes, hdr.scale_bytes)
     if got != hdr.crc:
         return (
             f"payload crc32 0x{got:08x} != committed 0x{hdr.crc:08x} "
-            f"(seq {hdr.seq}, producer {hdr.producer_idx})"
+            f"(seq {hdr.seq}, producer {hdr.producer_idx}, "
+            f"wire {hdr.wire_dtype})"
         )
     return None

@@ -52,7 +52,19 @@ timers, the ``serve.tenants`` / ``serve.pool_hosts`` /
 ``serve.stall.<tenant>`` admission-stall gauges; each tenant's own
 traffic rides ``ingest.<tenant>.*`` — ``bytes``/``windows``/``bursts``
 counters and the ``admission_wait`` timer — read back per tenant with
-:meth:`Metrics.prefixed`).
+:meth:`Metrics.prefixed`), and ``wire.*`` (the data-plane wire format,
+``ddl_tpu.wire`` — ``wire.encoded_bytes`` bytes that actually traveled
+an encode-engaged wire (slot commits, exchange envelopes, the ICI
+fan-out) next to ``wire.payload_bytes`` the same windows' logical raw
+bytes, the ``wire.decoded_windows`` consumer-edge decode counter, and
+the ladder counters ``wire.decode_fails`` / ``wire.fallbacks`` — a
+"passing" run that silently dropped its exchange to raw encoding must
+be visible in the BENCH_* trajectories.  Scope caveat, the standard
+producer.* one: slot-path decode counters are CONSUMER-side and
+surface in every mode, while the exchange wire's ladder events count
+in the shuffler's own registry — shared with the consumer in THREAD
+mode, per worker process in PROCESS mode, where the raw-latch also
+logs at ERROR).
 """
 
 from __future__ import annotations

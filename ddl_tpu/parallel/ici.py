@@ -103,6 +103,12 @@ class RedistLeg:
     ici_bytes: int  #: bytes this leg moves over ICI (wire, per window)
     peak_bytes: int  #: max per-device live bytes during the leg
     asynchronous: bool = False  #: emitted as a start/wait pair (fused)
+    #: Wire dtype of the bytes THIS leg moves (``ddl_tpu.wire``): a
+    #: quantized replicate leg reports the int8+scales bytes it
+    #: actually moves, never the raw window size — ``ici_bytes`` above
+    #: is already the encoded figure, this names the encoding so
+    #: ``bandwidth_utilization``'s numerator cannot flatter itself.
+    wire_dtype: str = "raw"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,6 +129,14 @@ class DistributionPlan:
     dst_shard_bytes: int  #: destination per-device shard size
     peak_factor: float  #: peak_bytes / window bytes (asserted bound)
     n_slots: int = 1  #: landing slots priced in flight (2 = fused)
+    #: Wire format the fan-out ring carries (``ddl_tpu.wire``): "raw"
+    #: moves the window's storage dtype; "bf16"/"int8" encode on the
+    #: anchor (device-side, jitted — never a host round trip), the ring
+    #: kernels move the uint8 payload (+ per-row scales), and the
+    #: finish legs decode at the landing edge.  ``wire_bytes``/leg
+    #: ``ici_bytes`` price the ENCODED bytes.
+    wire_dtype: str = "raw"
+    encoded_bytes: int = 0  #: 2D encoded bytes per window (== nbytes for raw)
 
     @property
     def anchor(self):
@@ -165,6 +179,20 @@ def _ring_order(mesh: Any, split_axes: Tuple[str, ...],
     return tuple(np.transpose(mesh.devices, order).reshape(-1))
 
 
+def _wire_cols(cols: int, dtype: Any, wire_dtype: str) -> int:
+    """uint8 columns of one encoded 2D row: the payload bytes plus (for
+    int8) the per-row fp32 block scales — scales travel WITH their rows
+    so any row split carries its own decode state.  Delegates to THE
+    size formulas in ``ddl_tpu.wire`` (one row = a (1, cols) window),
+    so the plan's pricing can never drift from what the encode
+    actually produces."""
+    from ddl_tpu import wire
+
+    return wire.encoded_nbytes(
+        (1, cols), dtype, wire_dtype
+    ) + wire.scale_bytes_for((1, cols), wire_dtype)
+
+
 def plan_distribution(
     shape: Sequence[int],
     dtype: Any,
@@ -172,6 +200,7 @@ def plan_distribution(
     max_memory_factor: Optional[float] = None,
     n_chunks: Optional[int] = None,
     n_slots: int = 1,
+    wire_dtype: str = "raw",
 ) -> DistributionPlan:
     """Plan the anchor→``sharding`` route for one window geometry.
 
@@ -190,6 +219,7 @@ def plan_distribution(
     computed peak exceeding ``max_memory_factor`` × the window) —
     callers fall back to the XLA path and count it.
     """
+    from ddl_tpu import wire as wire_mod
     from ddl_tpu.ops import ici_fanout
 
     shape = tuple(int(s) for s in shape)
@@ -207,6 +237,13 @@ def plan_distribution(
     if max_memory_factor is None:
         max_memory_factor = DEFAULT_MEMORY_FACTOR * n_slots
     fused = n_slots > 1
+    # Lossy wire only applies to float windows: an int/token geometry
+    # silently plans raw (values would corrupt for zero win) — the
+    # distributor's per-geometry plan cache makes this a per-geometry
+    # decision, exactly like the xla fallback.
+    wire_dtype = wire_mod.check_wire_dtype(wire_dtype)
+    if wire_dtype != "raw" and not wire_mod.lossy_supported(dtype):
+        wire_dtype = "raw"
 
     if split_dim is None:
         ring = _ring_order(mesh, (), rest_axes)
@@ -214,8 +251,11 @@ def plan_distribution(
         # mirror it so the plan prices what actually runs.
         rows = shape[0]
         n_chunks = max(1, min(n_chunks, rows))
+        enc = rows * _wire_cols(
+            int(np.prod(shape)) // rows, dtype, wire_dtype
+        )
         wire = ici_fanout.wire_bytes(
-            "replicate", nbytes, n_dev, n_chunks, rows=rows
+            "replicate", enc, n_dev, n_chunks, rows=rows
         )
         payload = ici_fanout.payload_bytes("replicate", nbytes, n_dev)
         # Per-device live: the window-sized SPMD landing block (cached —
@@ -224,13 +264,15 @@ def plan_distribution(
         # sink chunk riding along during the kernel).  Chunk = whole
         # padded rows, matching the kernel's row padding.  Every
         # ADDITIONAL in-flight landing slot pins one more landing +
-        # output set for its whole dispatch span.
-        chunk = -(-rows // n_chunks) * (nbytes // rows)
-        slot_live = 2 * nbytes + chunk
-        peak = n_slots * slot_live
+        # output set for its whole dispatch span.  Wire plans size the
+        # ring pieces at the ENCODED bytes and add the decoded output
+        # (raw size) the landing-edge decode materialises.
+        chunk = -(-rows // n_chunks) * (enc // rows)
+        slot_live = 2 * enc + chunk
+        peak = n_slots * slot_live + (nbytes if wire_dtype != "raw" else 0)
         legs = (
             RedistLeg("fanout.replicate", ("x",), wire, peak,
-                      asynchronous=fused),
+                      asynchronous=fused, wire_dtype=wire_dtype),
         )
         dst = nbytes
         plan = DistributionPlan(
@@ -239,6 +281,7 @@ def plan_distribution(
             legs=legs, wire_bytes=wire, payload_bytes=payload,
             peak_bytes=peak, dst_shard_bytes=dst,
             peak_factor=peak / nbytes, n_slots=n_slots,
+            wire_dtype=wire_dtype, encoded_bytes=enc,
         )
     else:
         split = shape[split_dim]
@@ -249,34 +292,44 @@ def plan_distribution(
             )
         g = int(np.prod([mesh.shape[a] for a in split_axes]))
         ring = _ring_order(mesh, split_axes, rest_axes)
-        wire = ici_fanout.wire_bytes("shard", nbytes, n_dev)
+        enc = split * _wire_cols(
+            int(np.prod(shape)) // split, dtype, wire_dtype
+        )
+        wire = ici_fanout.wire_bytes("shard", enc, n_dev)
         payload = ici_fanout.payload_bytes("shard", nbytes, n_dev)
-        block = nbytes // n_dev
+        block = enc // n_dev
         dst = nbytes // g
         # Scatter slot-live: the window-sized SPMD landing block (cached
         # on every ring device) + the output block + the kernel's
-        # double-buffered VMEM transit (2 blocks).  With the fused
-        # two-slot protocol the NEXT window's fan-out is live through
-        # every leg of this window's plan, so each leg carries one
-        # extra slot-live span.
-        slot_live = nbytes + 3 * block
+        # double-buffered VMEM transit (2 blocks) — all at the ENCODED
+        # size for wire plans.  With the fused two-slot protocol the
+        # NEXT window's fan-out is live through every leg of this
+        # window's plan, so each leg carries one extra slot-live span.
+        slot_live = enc + 3 * block
         extra = (n_slots - 1) * slot_live
         legs: List[RedistLeg] = [
             RedistLeg("fanout.shard", ("x",), wire, slot_live + extra,
-                      asynchronous=fused),
+                      asynchronous=fused, wire_dtype=wire_dtype),
         ]
+        dec_extra = nbytes // g if wire_dtype != "raw" else 0
         if rest_axes:
             m = n_dev // g
             # Tiled all_gather over the replication axes: each device
-            # receives the m-1 sibling blocks of its target shard (the
-            # pinned landing block + kernel output stay live under it).
+            # receives the m-1 sibling ENCODED blocks of its target
+            # shard (decode runs after the gather, so this leg moves
+            # wire bytes too); the pinned landing block + kernel output
+            # stay live under it, and the decoded shard (raw dst size)
+            # materialises at the landing edge.
             legs.append(
                 RedistLeg(
                     "all_gather", rest_axes, n_dev * (m - 1) * block,
-                    nbytes + block + dst + extra,
+                    enc + block + enc // g + dec_extra + extra,
+                    wire_dtype=wire_dtype,
                 )
             )
-        legs.append(RedistLeg("reshape", (), 0, nbytes + dst + extra))
+        legs.append(
+            RedistLeg("reshape", (), 0, enc + dst + dec_extra + extra)
+        )
         peak = max(leg.peak_bytes for leg in legs)
         plan = DistributionPlan(
             mode="shard", shape=shape, dtype=dtype, split_dim=split_dim,
@@ -286,6 +339,7 @@ def plan_distribution(
             ),
             payload_bytes=payload, peak_bytes=peak, dst_shard_bytes=dst,
             peak_factor=peak / nbytes, n_slots=n_slots,
+            wire_dtype=wire_dtype, encoded_bytes=enc,
         )
     if plan.peak_factor > max_memory_factor:
         raise PlanError(
@@ -332,14 +386,99 @@ def _to2d_call(device: Any, shape: Tuple[int, ...], dtype_name: str,
     return jax.jit(body, out_shardings=sds)
 
 
+def _jx_encode2d(x: Any, wire_dtype: str) -> Any:
+    """Device-side 2D wire encode (traced): float rows → uint8 rows.
+
+    bf16 bitcasts to 2 bytes/value; int8 rides the SAME blockwise
+    quantizer the optimizer wire uses
+    (``parallel.collectives.quantize_blockwise``) with the per-row fp32
+    scales bitcast and concatenated after the payload columns — scales
+    travel WITH their rows, so any row split carries its decode state.
+    Runs on the anchor inside a jitted call: the window is never
+    materialised at fp32 between the encode and the ring send.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows = x.shape[0]
+    if wire_dtype == "bf16":
+        b = lax.bitcast_convert_type(x.astype(jnp.bfloat16), jnp.uint8)
+        return b.reshape(rows, -1)
+    from ddl_tpu import wire
+    from ddl_tpu.parallel.collectives import quantize_blockwise
+
+    q, s = quantize_blockwise(x.astype(jnp.float32), wire.QUANT_BLOCK)
+    qb = lax.bitcast_convert_type(q, jnp.uint8)
+    sb = lax.bitcast_convert_type(s, jnp.uint8).reshape(rows, -1)
+    return jnp.concatenate([qb, sb], axis=1)
+
+
+def _jx_decode2d(w: Any, cols: int, dtype: Any, wire_dtype: str) -> Any:
+    """Inverse of :func:`_jx_encode2d` (traced, landing-edge local)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows = w.shape[0]
+    if wire_dtype == "bf16":
+        v = lax.bitcast_convert_type(
+            w.reshape(rows, cols, 2), jnp.bfloat16
+        )
+        return v.astype(dtype)
+    from ddl_tpu import wire
+    from ddl_tpu.parallel.collectives import dequantize_blockwise
+
+    nblk = -(-cols // wire.QUANT_BLOCK)
+    q = lax.bitcast_convert_type(w[:, :cols], jnp.int8)
+    s = lax.bitcast_convert_type(
+        w[:, cols:].reshape(rows, nblk, 4), jnp.float32
+    )
+    return dequantize_blockwise(q, s, dtype, wire.QUANT_BLOCK)
+
+
+@functools.lru_cache(maxsize=64)
+def _encode2d_call(device: Any, rows: int, cols: int, dtype_name: str,
+                   wire_dtype: str):
+    """Anchor-local jitted wire encode: (rows, cols) dtype → (rows,
+    wire_cols) uint8, pinned to the anchor device (one compiled program
+    per geometry, like :func:`_to2d_call`)."""
+    import jax
+
+    sds = jax.sharding.SingleDeviceSharding(device)
+    return jax.jit(
+        lambda x: _jx_encode2d(x, wire_dtype), out_shardings=sds
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _finish_replicate_wire_call(mesh_key: _MeshKey, shape: Tuple[int, ...],
+                                dtype_name: str, wire_dtype: str):
+    """Replicated encoded 2D view → decoded window at the target mesh's
+    fully-replicated sharding (decode is per-device local compute — the
+    landing-edge dequantize)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh_key.mesh
+    cols = int(np.prod(shape)) // shape[0]
+    sharding = NamedSharding(mesh, P(*([None] * len(shape))))
+    dtype = np.dtype(dtype_name)
+    return jax.jit(
+        lambda w: _jx_decode2d(w, cols, dtype, wire_dtype).reshape(shape),
+        out_shardings=sharding,
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _finish_shard_call(mesh_key: _MeshKey, shape: Tuple[int, ...],
                        dtype_name: str, split_dim: int,
                        split_axes: Tuple[str, ...],
-                       rest_axes: Tuple[str, ...]):
+                       rest_axes: Tuple[str, ...],
+                       wire_dtype: str = "raw"):
     """The single finish collective for shard mode: gather the
     replication axes (tiled on the split dim), restore the window's dim
-    order locally, land on the exact target spec."""
+    order locally, land on the exact target spec.  Wire plans gather
+    the ENCODED rows (the gather leg moves wire bytes too) and decode
+    at the landing edge, after the collective."""
     import jax
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -350,8 +489,10 @@ def _finish_shard_call(mesh_key: _MeshKey, shape: Tuple[int, ...],
     other_dims = tuple(
         d for d in range(len(shape)) if d != split_dim
     )
+    cols = int(np.prod(shape)) // shape[split_dim]
+    dtype = np.dtype(dtype_name)
 
-    def body(x):  # x: (split_local, flat_features)
+    def body(x):  # x: (split_local, flat_features | wire_cols)
         if rest_axes:
             x = lax.all_gather(
                 x, rest_axes if len(rest_axes) > 1 else rest_axes[0],
@@ -359,6 +500,8 @@ def _finish_shard_call(mesh_key: _MeshKey, shape: Tuple[int, ...],
             )
         import jax.numpy as jnp
 
+        if wire_dtype != "raw":
+            x = _jx_decode2d(x, cols, dtype, wire_dtype)
         x = x.reshape((x.shape[0],) + tuple(shape[d] for d in other_dims))
         return jnp.moveaxis(x, 0, split_dim)
 
@@ -437,9 +580,18 @@ class IciDistributor:
         max_memory_factor: Optional[float] = None,
         n_chunks: Optional[int] = None,
         n_slots: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
     ):
+        from ddl_tpu import wire
         from ddl_tpu.ops import ici_fanout
 
+        # Wire format the fan-out carries (ddl_tpu.wire): encode on the
+        # anchor, move uint8 over the ring, decode at the landing edge.
+        # None defers to DDL_TPU_WIRE_DTYPE (the one data-plane knob);
+        # pass "raw" explicitly when the slot wire already encoded
+        # upstream — re-quantizing a decoded window erases the win
+        # (ddl-lint DDL021's decode-then-requantize finding).
+        self.wire_dtype = wire.resolve_wire_dtype(wire_dtype)
         self.sharding = sharding
         self.metrics = metrics or default_metrics()
         self.interpret = interpret
@@ -485,6 +637,7 @@ class IciDistributor:
                     key[0], key[1], self.sharding,
                     max_memory_factor=self.max_memory_factor,
                     n_chunks=self.n_chunks, n_slots=self.n_slots,
+                    wire_dtype=self.wire_dtype,
                 )
             except PlanError as e:
                 hit = e
@@ -561,6 +714,15 @@ class IciDistributor:
             flat = _to2d_call(
                 plan.anchor, plan.shape, dtype_name, 0
             )(block)
+            if plan.wire_dtype != "raw":
+                # Anchor-side device encode: the ring moves uint8 wire
+                # rows; the window is never a host fp32 temp between
+                # the encode and the send (DDL021 discipline).
+                flat = _encode2d_call(
+                    plan.anchor, plan.shape[0],
+                    int(np.prod(plan.shape)) // plan.shape[0],
+                    dtype_name, plan.wire_dtype,
+                )(flat)
             ticket = ici_fanout.fanout_start(
                 "replicate", flat, plan.ring_devices, src=0, slot=slot,
                 n_chunks=self.n_chunks or ici_fanout.DEFAULT_CHUNKS,
@@ -571,14 +733,26 @@ class IciDistributor:
             rep = ici_fanout.replicated_view(
                 ici_fanout.fanout_wait(ticket), plan.ring_devices
             )
-            result = _finish_replicate_call(
-                self._mesh_key, plan.shape, dtype_name
-            )(rep)
+            if plan.wire_dtype != "raw":
+                result = _finish_replicate_wire_call(
+                    self._mesh_key, plan.shape, dtype_name,
+                    plan.wire_dtype,
+                )(rep)
+            else:
+                result = _finish_replicate_call(
+                    self._mesh_key, plan.shape, dtype_name
+                )(rep)
             m.add_time("ici.redistribute", time.perf_counter() - t1)
         else:
             flat = _to2d_call(
                 plan.anchor, plan.shape, dtype_name, plan.split_dim
             )(block)
+            if plan.wire_dtype != "raw":
+                flat = _encode2d_call(
+                    plan.anchor, plan.shape[plan.split_dim],
+                    int(np.prod(plan.shape)) // plan.shape[plan.split_dim],
+                    dtype_name, plan.wire_dtype,
+                )(flat)
             ticket = ici_fanout.fanout_start(
                 "shard", flat, plan.ring_devices, src=0, slot=slot,
                 interpret=self.interpret,
@@ -587,7 +761,7 @@ class IciDistributor:
             t1 = time.perf_counter()
             result = _finish_shard_call(
                 self._mesh_key, plan.shape, dtype_name, plan.split_dim,
-                plan.split_axes, plan.rest_axes,
+                plan.split_axes, plan.rest_axes, plan.wire_dtype,
             )(self._onto_mesh(ici_fanout.fanout_wait(ticket), plan))
             m.add_time("ici.redistribute", time.perf_counter() - t1)
         key = (plan.shape, np.dtype(plan.dtype).name)
@@ -613,6 +787,14 @@ class IciDistributor:
             m.incr("ici.fused_windows")
         self._track_in_flight(result)
         m.incr("ici.bytes", float(plan.wire_bytes))
+        if plan.wire_dtype != "raw":
+            # Wire accounting (ddl_tpu.wire): what the ring actually
+            # moved per window vs the logical raw bytes it delivered.
+            m.incr("wire.encoded_bytes", float(plan.encoded_bytes))
+            m.incr(
+                "wire.payload_bytes",
+                float(int(np.prod(plan.shape)) * plan.dtype.itemsize),
+            )
         m.incr("ici.windows")
         m.set_gauge("ici.peak_bytes", float(plan.peak_bytes))
         return result

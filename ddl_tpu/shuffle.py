@@ -398,11 +398,34 @@ class ThreadExchangeShuffler:
         exchange_timeout_s: float = 60.0,
         degrade_on_peer_loss: bool = True,
         max_peer_losses: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
+        codec: Optional[str] = None,
+        codec_level: int = 3,
     ):
         if exchange_method not in EXCHANGE_METHODS:
             raise NotImplementedError(
                 f"exchange_method {exchange_method!r}; valid: {EXCHANGE_METHODS}"
             )
+        # Exchange wire format (ddl_tpu.wire): the lanes travel the
+        # rendezvous fabric (thread board / shm mailboxes — the DCN
+        # analog in PROCESS topologies) as self-describing envelopes —
+        # blockwise bf16/int8 and/or codec-compressed — instead of raw
+        # fp32 rows.  Self-describing matters: the DECODER needs no
+        # out-of-band agreement, so a peer that latched the raw
+        # fallback still interoperates.  Defaults resolve from the
+        # DDL_TPU_WIRE_DTYPE / DDL_TPU_WIRE_CODEC env (the same knobs
+        # the slot wire honors); raw + no codec keeps the pre-wire
+        # byte-for-byte puts.
+        from ddl_tpu import wire as _wire
+
+        self.wire_dtype = _wire.resolve_wire_dtype(wire_dtype)
+        self.codec = _wire.resolve_wire_codec(codec)
+        self.codec_level = int(codec_level)
+        # Per-shuffler raw fallback latch: a persistent decode failure
+        # (DECODE_FAIL budget exhausted, foreign-codec peer) drops THIS
+        # producer's outgoing encoding to raw for the rest of the run
+        # (wire.fallbacks) — incoming envelopes still decode fine.
+        self._wire_raw = False
         self.topology = topology
         self.producer_idx = producer_idx
         self.num_exchange = num_exchange
@@ -497,6 +520,64 @@ class ThreadExchangeShuffler:
         implements its own round re-entry here."""
         self._round = int(round_)
 
+    def _wire_active(self, rows: np.ndarray) -> Tuple[str, Optional[str]]:
+        """The (wire_dtype, codec) this put actually uses: the raw
+        latch wins, lossy needs float rows (token/int lanes keep raw —
+        the codec still applies), raw+None is the pre-wire fast path."""
+        if self._wire_raw:
+            return "raw", None
+        from ddl_tpu import wire as _wire
+
+        wd = self.wire_dtype
+        if wd != "raw" and not _wire.lossy_supported(rows.dtype):
+            wd = "raw"
+        return wd, self.codec
+
+    def _encode_lane(self, rows: np.ndarray) -> np.ndarray:
+        wd, codec = self._wire_active(rows)
+        if wd == "raw" and codec is None:
+            return rows.copy()  # pre-wire behavior, byte-for-byte
+        from ddl_tpu import wire as _wire
+
+        return _wire.pack_rows(
+            rows, wd, codec=codec, level=self.codec_level,
+            metrics=self.metrics,
+        )
+
+    def _decode_lane(self, rows: np.ndarray) -> np.ndarray:
+        """Decode a taken lane: raw arrays pass through (a peer on the
+        raw fallback — or a pre-wire peer — interoperates), envelopes
+        unpack with ONE bounded retry; a persistent decode failure
+        latches this producer's outgoing encoding to raw
+        (``wire.fallbacks``) and raises — the round then degrades to
+        the node-local shuffle via the existing peer-loss rung."""
+        from ddl_tpu import wire as _wire
+        from ddl_tpu.exceptions import DecodeError
+
+        if not (
+            rows.ndim == 1
+            and rows.dtype == np.uint8
+            and rows.nbytes >= 4
+            and int.from_bytes(rows[:4].tobytes(), "little")
+            == _wire._PACK_MAGIC
+        ):
+            return rows  # raw lane
+        for attempt in (1, 2):
+            try:
+                return _wire.unpack_rows(rows, metrics=self.metrics)
+            except DecodeError:
+                self.metrics.incr("wire.decode_fails")
+                if attempt == 2:
+                    if not self._wire_raw:
+                        self._wire_raw = True
+                        self.metrics.incr("wire.fallbacks")
+                        logger.error(
+                            "global shuffle: exchange wire decode failed "
+                            "twice — this producer sends RAW lanes for "
+                            "the rest of the run"
+                        )
+                    raise
+
     def _local_shuffle(self, my_ary: np.ndarray) -> None:
         """Node-local fallback: a deterministic in-place row permutation
         seeded by (seed, producer, round) — preserves this producer's row
@@ -581,17 +662,19 @@ class ThreadExchangeShuffler:
             (lane_b, int(pinv[me]), int(p[me]), tag + 1),
         ):
             put_key = (self.producer_idx, t, dest)
-            self._rdv.put(put_key, my_ary[lane].copy())
+            self._rdv.put(put_key, self._encode_lane(my_ary[lane]))
             if n == 2:  # the sweep only runs (and is only safe) at n == 2
                 self._sent.append((self._round, put_key))
             try:
                 fault_point(
                     "shuffle.exchange", producer_idx=self.producer_idx
                 )
-                my_ary[lane] = self._rdv.take(
-                    (self.producer_idx, t, me),
-                    timeout_s=self.exchange_timeout_s,
-                    should_abort=should_abort,
+                my_ary[lane] = self._decode_lane(
+                    self._rdv.take(
+                        (self.producer_idx, t, me),
+                        timeout_s=self.exchange_timeout_s,
+                        should_abort=should_abort,
+                    )
                 )
             except ShutdownRequested:
                 # Clean teardown: retract our half so a later run on the
@@ -625,6 +708,9 @@ class ThreadExchangeShuffler:
         exchange_timeout_s: float = 60.0,
         degrade_on_peer_loss: bool = True,
         max_peer_losses: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
+        codec: Optional[str] = None,
+        codec_level: int = 3,
     ):
         return ExchangeShufflerFactory(
             rendezvous=rendezvous,
@@ -632,6 +718,9 @@ class ThreadExchangeShuffler:
             exchange_timeout_s=exchange_timeout_s,
             degrade_on_peer_loss=degrade_on_peer_loss,
             max_peer_losses=max_peer_losses,
+            wire_dtype=wire_dtype,
+            codec=codec,
+            codec_level=codec_level,
         )
 
 
@@ -652,12 +741,18 @@ class ExchangeShufflerFactory:
         exchange_timeout_s: float = 60.0,
         degrade_on_peer_loss: bool = True,
         max_peer_losses: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
+        codec: Optional[str] = None,
+        codec_level: int = 3,
     ):
         self.rendezvous = rendezvous
         self.seed = seed
         self.exchange_timeout_s = exchange_timeout_s
         self.degrade_on_peer_loss = degrade_on_peer_loss
         self.max_peer_losses = max_peer_losses
+        self.wire_dtype = wire_dtype
+        self.codec = codec
+        self.codec_level = codec_level
 
     def __call__(
         self,
@@ -676,4 +771,7 @@ class ExchangeShufflerFactory:
             exchange_timeout_s=self.exchange_timeout_s,
             degrade_on_peer_loss=self.degrade_on_peer_loss,
             max_peer_losses=self.max_peer_losses,
+            wire_dtype=self.wire_dtype,
+            codec=self.codec,
+            codec_level=self.codec_level,
         )

@@ -94,6 +94,13 @@ class MetaData_Producer_To_Consumer:
     #: past each slot payload; the consumer verifies at drain.  Carried in
     #: the handshake so producer and consumer always agree on slot layout.
     integrity: bool = False
+    #: Wire format this producer's slots are committed in
+    #: (``ddl_tpu.wire``): ``"raw"`` (the storage dtype) or the
+    #: blockwise-encoded ``"bf16"``/``"int8"`` lossy tier — ``shape``/
+    #: ``dtype`` above always describe the LOGICAL window; the consumer
+    #: decodes at its edge.  Carried in the handshake so both sides
+    #: agree on slot layout, exactly like ``integrity``.
+    wire_dtype: str = "raw"
 
 
 @dataclasses.dataclass

@@ -219,6 +219,11 @@ class TestNorthStarReport:
             # ddl_tpu.serve — admission + autoscaler)
             "serve_tenants", "serve_scale_ups", "serve_scale_downs",
             "serve_admission_waits_s", "serve_tenant_stall",
+            # data-plane wire format extras (ISSUE 13: ddl_tpu.wire —
+            # honest encoded/raw byte pair + ladder counters)
+            "wire_encoded_bytes", "wire_payload_bytes",
+            "wire_decoded_windows", "wire_decode_fails",
+            "wire_fallbacks",
         }
         assert r["samples_per_sec"] > 0
         # The per-tenant stall block is a DICT keyed by tenant name

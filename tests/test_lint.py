@@ -213,6 +213,19 @@ VIOLATIONS = {
             def _distribute_planned(self, ticket):
                 return fanout_wait(ticket, sync=True)  # forced wait
     """,
+    "DDL021": """
+        class ThreadExchangeShuffler:
+            def _encode_lane(self, rows):
+                # decode-then-requantize: the fp32 temp between encode
+                # and send that erases the wire win
+                raw = decode_window(rows, None, rows.shape, "f4", "int8")
+                return pack_rows(raw, "int8")
+
+        class CodecBackend:
+            def open(self, path):
+                data = self.inner.open(path).read()
+                return self.codec.decode_bytes(data)   # unbounded decode
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -491,6 +504,24 @@ CLEAN = {
         class IciDistributor:
             def _distribute_planned(self, ticket):
                 return fanout_wait(ticket)      # data-dependence wait: clean
+    """,
+    "DDL021": """
+        class ThreadExchangeShuffler:
+            def _encode_lane(self, rows):
+                return pack_rows(rows, "int8")   # encode from RAW rows
+
+            def _decode_lane(self, rows):
+                # decode at the consumer edge, never re-encoded
+                return unpack_rows(rows, max_output=1 << 20)
+
+        class CodecBackend:
+            def open(self, path):
+                data = self.inner.open(path).read()
+                return self.codec.decode_bytes(data, max_output=1 << 30)
+
+        def helper_outside_wire_path(rows):
+            raw = decode_window(rows, None, rows.shape, "f4", "int8")
+            return pack_rows(raw, "int8")   # not a configured function
     """,
 }
 
@@ -771,6 +802,56 @@ class TestSelfTest:
         """
         findings = lint_snippet(tmp_path, "DDL019", clean)
         assert findings == [], findings
+
+    def test_ddl021_respects_configured_wire_path_list(self, tmp_path):
+        """The decode-then-requantize ban is scoped to
+        wire_path_functions — the same shape outside the config stays
+        clean, and a directly NESTED decode inside an encode call fires
+        without needing a named temp."""
+        src = """
+            class CustomWire:
+                def send(self, rows):
+                    return pack_rows(
+                        decode_window(rows, None, rows.shape, "f4", "int8"),
+                        "int8",
+                    )
+        """
+        cfg = LintConfig(wire_path_functions=["OtherWire.send"])
+        findings = lint_snippet(tmp_path, "DDL021", src, config=cfg)
+        assert findings == [], findings
+        cfg = LintConfig(wire_path_functions=["CustomWire.send"])
+        findings = lint_snippet(tmp_path, "DDL021", src, config=cfg)
+        assert [f.code for f in findings] == ["DDL021"]
+
+    def test_ddl021_named_temp_alone_fires(self, tmp_path):
+        """The canonical decode-then-requantize form — decode assigned
+        to a local name, name fed to an encode call — must fire on its
+        own (regression: the single-sweep walk visited statements in
+        reverse source order and never saw the assignment first)."""
+        src = """
+            class ThreadExchangeShuffler:
+                def _encode_lane(self, rows):
+                    raw = decode_window(rows, None, rows.shape, "f4", "int8")
+                    return pack_rows(raw, "int8")
+        """
+        findings = lint_snippet(tmp_path, "DDL021", src)
+        assert [f.code for f in findings] == ["DDL021"]
+
+    def test_ddl021_positional_bound_passes_kwargless_fires(self, tmp_path):
+        """encode_bytes(data, 3) fills the positional bound slot —
+        clean; compress(data) with neither kwarg nor second positional
+        is unbounded — fires."""
+        src = """
+            import zlib
+
+            class DataPusher:
+                def _encode_and_commit(self, view):
+                    a = self.codec.encode_bytes(view, 3)     # positional
+                    b = zlib.compress(view)                  # unbounded
+                    return a, b
+        """
+        findings = lint_snippet(tmp_path, "DDL021", src)
+        assert [f.code for f in findings] == ["DDL021"]
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

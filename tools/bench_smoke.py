@@ -43,6 +43,12 @@ Four passes:
    chaos leg (injected TENANT_BURST + simultaneous HOST_LOSS) must show
    both faults fired, every tenant byte-correct with full shard
    coverage, and zero watchdog failures.
+2e. `DDL_BENCH_MODE=wire` — the data-plane wire-format A/B block must
+   carry its contract keys; the best of the encoded legs (int8 /
+   codec) must beat raw on the throttled link (never-slower, retried
+   once), the lossless leg must be byte-identical to raw, the int8 leg
+   must pass the loss-parity gate with NONZERO drift, and the winning
+   leg's `wire_bytes` must undercut raw at equal `payload_bytes`.
 3. `DDL_BENCH_MODE=train` — the `fit_stream` block must carry the
    overlap-health keys (`window_wait_s`, `release_wait_s`,
    schedule/bubble gauges, the ISSUE-12 fused extras) and the FUSED
@@ -212,6 +218,19 @@ REQUIRED_TENANT = (
 MIN_TENANCY_VS_STATIC = 1.0
 #: The ISSUE 11 acceptance floor on concurrent tenants.
 MIN_TENANTS = 3
+#: The wire block's contract (ISSUE 13: DDL_BENCH_MODE=wire — raw vs
+#: quantized vs compressed exchange wire over a throttled link).
+#: ``samples_per_sec`` must be the measured winner (never-slower), the
+#: best of the encoded legs must beat raw on the constrained link, the
+#: lossless leg must be byte-identical, the int8 leg must pass the
+#: loss-parity gate with NONZERO drift, and the winner's wire_bytes
+#: must be strictly below raw's at equal payload_bytes.
+REQUIRED_WIRE = (
+    "samples_per_sec", "winner", "never_slower", "legs", "codec",
+    "byte_identical", "parity", "parity_drift", "winner_wire_below_raw",
+    "wire_vs_raw", "link_bytes_per_sec", "rounds",
+)
+REQUIRED_WIRE_LEG = ("samples_per_sec", "wire_bytes", "payload_bytes")
 
 
 def _run_bench(mode: str) -> "dict | None":
@@ -693,6 +712,100 @@ def main() -> int:
             "recovery was misreported as failure"
         )
         return 1
+    # -- pass 2e: the data-plane wire format (ISSUE 13) ----------------
+    for attempt in range(1, 3):
+        wr_result = _run_bench("wire")
+        if wr_result is None:
+            return 1
+        wr = wr_result.get("wire")
+        if not isinstance(wr, dict):
+            print(json.dumps(wr_result, indent=1))
+            print(
+                "bench-smoke: no wire block "
+                f"(errors={wr_result.get('errors')})"
+            )
+            return 1
+        wr_missing = [k for k in REQUIRED_WIRE if k not in wr]
+        for name, leg in (wr.get("legs") or {}).items():
+            wr_missing += [
+                f"legs.{name}.{k}"
+                for k in REQUIRED_WIRE_LEG
+                if k not in leg
+            ]
+        if wr_missing:
+            print(json.dumps(wr, indent=1))
+            print(f"bench-smoke: wire block missing keys: {wr_missing}")
+            return 1
+        legs = {
+            n: leg["samples_per_sec"] for n, leg in wr["legs"].items()
+        }
+        wr_problems = []
+        # never_slower is a fresh interleaved confirmation pair
+        # (winner vs raw re-measured after selection) — the meaningful
+        # invariant; comparing the headline against max() of the same
+        # dict it was selected from would be a tautology.
+        if wr["never_slower"] is not True:
+            wr_problems.append(
+                f"wire winner {wr['winner']!r} lost to raw in the "
+                f"confirmation re-measure ({wr.get('confirm')}) — "
+                "never-slower invariant violated"
+            )
+        if (
+            wr["winner"] != max(legs, key=legs.get)
+            or wr_result.get("headline_config") != wr["winner"]
+        ):
+            wr_problems.append(
+                f"wire winner label {wr['winner']!r} / headline_config "
+                f"{wr_result.get('headline_config')!r} do not name the "
+                f"measured winner ({legs})"
+            )
+        best_encoded = max(
+            rate for name, rate in legs.items() if name != "raw"
+        )
+        if best_encoded < legs["raw"]:
+            wr_problems.append(
+                f"best encoded leg {best_encoded} lost to raw "
+                f"{legs['raw']} on the throttled link — the wire format "
+                "bought nothing where it is designed to win"
+            )
+        if not wr_problems:
+            break
+        if attempt < 2:
+            print(
+                f"bench-smoke: wire gates failed ({wr_problems}); "
+                "retrying once (one-sided box noise)"
+            )
+            continue
+        print(json.dumps(wr, indent=1))
+        for p in wr_problems:
+            print(f"bench-smoke: {p}")
+        return 1
+    # Deterministic gates — never retried: the lossless leg must be
+    # byte-identical, the lossy leg must PASS the parity gate with
+    # NONZERO drift (zero drift = the wire silently wasn't engaged),
+    # and the winner's wire bytes must undercut raw at equal payload.
+    if wr["byte_identical"] is not True:
+        print(json.dumps(wr, indent=1))
+        print(
+            "bench-smoke: lossless wire leg NOT byte-identical to raw — "
+            "the codec tier changed data"
+        )
+        return 1
+    if wr["parity"] is not True or not (0.0 < wr["parity_drift"]):
+        print(json.dumps(wr, indent=1))
+        print(
+            "bench-smoke: int8 wire leg parity gate "
+            f"(parity={wr['parity']}, drift={wr['parity_drift']}) — "
+            "either the lossy wire broke training or it never engaged"
+        )
+        return 1
+    if wr["winner_wire_below_raw"] is not True:
+        print(json.dumps(wr, indent=1))
+        print(
+            "bench-smoke: the winning leg's wire_bytes do not undercut "
+            "raw at equal payload_bytes — the headline is not a wire win"
+        )
+        return 1
     # -- pass 3: the fused training hot path (ISSUE 5 + 12) ------------
     for attempt in range(1, FIT_ATTEMPTS + 1):
         train = _run_bench("train")
@@ -789,6 +902,9 @@ def main() -> int:
         f"({tn['n_tenants']} tenants, reaction "
         f"{tn['scale_up_reaction_s']}s, chaos byte-correct, "
         f"watchdog_failures={tn_chaos['watchdog_failures']}); "
+        f"wire winner {wr['winner']} vs_raw {wr['wire_vs_raw']} "
+        f"(parity drift {wr['parity_drift']:.1e}, lossless "
+        "byte-identical, winner wire bytes < raw); "
         "fit_stream fused "
         f"{fit['fused']['pipeline_overhead']} <= {PIPELINE_OVERHEAD_MAX} "
         f"where unfused {fit['unfused']['pipeline_overhead']} >= "

@@ -25,13 +25,17 @@
 #                bytes raw vs int8, loss parity) — ROADMAP item 2's
 #                pending chip half: train_big MFU with
 #                DDL_TPU_TRAIN_OPTIMIZER_SHARDING=zero1
+#   8. fused  - fused compute/ingest fit A/B with real DMAs + the
+#                stream re-measure (bandwidth_utilization >= 0.90)
+#   9. wire   - wire-format probe (break-even links) + exchange-wire
+#                A/B at DCN bandwidth + quantized ICI fan-out re-run
 set -u
 cd "$(dirname "$0")/.."
 ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/8] probe =="
+echo "== [1/9] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -41,23 +45,23 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/8] on-chip test suite =="
+echo "== [2/9] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/8] full bench =="
+echo "== [3/9] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/8] big-model MFU bench =="
+echo "== [4/9] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
 
-echo "== [4b/8] serving decode bench (small + big, MBU-graded) =="
+echo "== [4b/9] serving decode bench (small + big, MBU-graded) =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
   2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
-echo "== [5/8] stream-bandwidth diagnosis + window-size sweep =="
+echo "== [5/9] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
 # fail loudly (step timeout), never silently record CPU numbers in a
 # TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
@@ -77,7 +81,7 @@ for MIB in 64 128; do
     | tee "$ART/bench-stream-$MIB-$STAMP.json"
 done
 
-echo "== [6/8] ICI fan-out probe + distribution A/B =="
+echo "== [6/9] ICI fan-out probe + distribution A/B =="
 # Real remote-DMA numbers for the device-side distribution tier
 # (ddl_tpu/parallel/ici.py): per-hop bytes/s from the kernel probe,
 # then the ici-vs-xla A/B with link utilization against the per-link
@@ -88,7 +92,7 @@ DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_ici.py \
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici timeout 1200 python bench.py \
   2> "$ART/bench-ici-$STAMP.err" | tee "$ART/bench-ici-$STAMP.json"
 
-echo "== [7/8] distributed-optimizer probe + A/B =="
+echo "== [7/9] distributed-optimizer probe + A/B =="
 # The zero1/int8 measurement the ISSUE-8 artifact needs on real HBM:
 # state bytes/replica from placed shardings, the int8 gather leg on
 # real ICI, loss parity re-asserted on-chip.  Then the train_big MFU
@@ -104,7 +108,7 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big \
   2> "$ART/bench-big-zero1-$STAMP.err" \
   | tee "$ART/bench-big-zero1-$STAMP.json"
 
-echo "== [8/8] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
+echo "== [8/9] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
 # The fused compute/ingest step measured with REAL DMAs: (a) the
 # train-mode fit_stream leg carries the fused-vs-unfused A/B (on TPU
 # the unfused leg exposes the genuine H2D + ICI fan-out latency — no
@@ -125,5 +129,27 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=stream \
   timeout 1200 python bench.py \
   2> "$ART/bench-fused-stream-$STAMP.err" \
   | tee "$ART/bench-fused-stream-$STAMP.json"
+
+echo "== [9/9] wire-format A/B on real ICI/DCN links (ISSUE 13) =="
+# The wire tier re-measured where the links are real: (a) probe_wire on
+# the chip host prices encode/decode CPU against the REAL link speeds
+# (the break_even_link_mib_s table decides whether int8/bf16 pays off
+# on ICI at all — a v5e ICI link is ~2x the CPU-measured int8
+# break-even, so expect raw to win ON-CHIP hops and the encoded legs
+# to win the DCN/host legs); (b) the exchange-wire A/B at a realistic
+# DCN bandwidth; (c) the ICI ingest A/B re-run with the quantized
+# fan-out forced on, compared against the step-8 fused-stream artifact
+# at equal payload_bytes — wire_bytes must undercut step 8's at the
+# same bandwidth_utilization gate, or the lossy ICI tier stays off in
+# deployment guidance.
+DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_wire.py \
+  2> "$ART/probe-wire-$STAMP.err" | tee "$ART/probe-wire-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=wire \
+  DDL_BENCH_WIRE_LINK_MBPS=2048 timeout 1200 python bench.py \
+  2> "$ART/bench-wire-$STAMP.err" | tee "$ART/bench-wire-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici DDL_TPU_WIRE_DTYPE=int8 \
+  timeout 1200 python bench.py \
+  2> "$ART/bench-ici-wire-$STAMP.err" \
+  | tee "$ART/bench-ici-wire-$STAMP.json"
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="

@@ -1,0 +1,181 @@
+"""Wire-path invariants: the encoded bytes must stay encoded, and every
+codec call must be bounded.
+
+The wire format (``ddl_tpu/wire.py``) earns its keep only while the
+bytes between an encode and the send stay encoded: a function that
+DECODES a payload back to fp32 and re-encodes it (the
+decode-then-requantize temp) silently pays one full-window fp32
+materialisation plus a second quantization error — erasing the wire win
+while the bench still reports the small wire bytes.  And a codec call
+without an explicit bound is an allocator hazard: encode without a
+``level`` pins the library default (which drifts across versions, so
+measured ratios stop reproducing), decode without a ``max_output`` lets
+a corrupt length header balloon the decoder.  Repo rule (docs/LINT.md
+DDL021): in a configured wire-path function, decode-family results
+never feed encode-family calls, and every ``encode_bytes``/
+``decode_bytes``/``compress``/``decompress`` call carries its bound.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Decode-family call names: their result is a DECODED (fp32-sized)
+#: window/lane materialisation.
+_DECODE_CALLS = {
+    "decode_window", "dequantize_blockwise", "dequantize_rows",
+    "unpack_rows",
+}
+
+#: Encode-family call names: feeding them a decode-family result is the
+#: decode-then-requantize temp.
+_ENCODE_CALLS = {
+    "encode_window", "quantize_blockwise", "quantize_rows", "pack_rows",
+}
+
+#: Codec calls and the bound each must carry (kwarg name).  Positional
+#: forms pass when the bound argument position is filled (arg index 1).
+_CODEC_BOUNDS = {
+    "encode_bytes": "level",
+    "compress": "level",
+    "decode_bytes": "max_output",
+    "decompress": "max_output",
+}
+
+
+def _walk_no_defs(root: ast.AST):
+    """Walk without descending into nested function/class defs."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def _call_names_in(node: ast.AST) -> Set[str]:
+    return {
+        last_segment(n.func)
+        for n in ast.walk(node)
+        if isinstance(n, ast.Call)
+    }
+
+
+@register
+class WirePath(Checker):
+    """DDL021: wire-path functions keep encoded bytes encoded and bound
+    every codec call.
+
+    Functions named in ``[tool.ddl_lint] wire_path_functions`` (bare
+    names or ``Class.method``) sit between an encode and a send.
+    Inside one:
+
+    - a decode-family result (``decode_window`` / ``unpack_rows`` /
+      ``dequantize_*``) must never feed an encode-family call
+      (``encode_window`` / ``pack_rows`` / ``quantize_*``) — directly
+      nested or through a local name — that round trip materialises
+      the full fp32 window between encode and send and double-pays the
+      quantization error;
+    - every ``encode_bytes``/``compress`` call must carry an explicit
+      ``level`` and every ``decode_bytes``/``decompress`` an explicit
+      ``max_output`` (kwarg, or the filled positional slot).
+
+    Escape hatch: ``# ddl-lint: disable=DDL021`` with a rationale.
+    """
+
+    code = "DDL021"
+    summary = "wire-path decode-then-requantize or unbounded codec call"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_wire_fn(node):
+            self._check(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_wire_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "wire_path_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check(self, fn: ast.AST) -> None:
+        # Pass 1: collect every name assigned from a decode-family call
+        # ANYWHERE in the function.  Two passes because the walk is not
+        # source-ordered (a stack DFS visits statements in reverse), so
+        # checking encode calls against a set built in the same sweep
+        # silently missed the canonical `x = decode_*(...); encode(x)`
+        # form.  Order-insensitivity is deliberately conservative: a
+        # decoded temp feeding an encode anywhere in one wire-path
+        # function is the finding, whichever line comes first.
+        decoded_names: Set[str] = set()
+        for node in _walk_no_defs(fn):
+            if isinstance(node, ast.Assign):
+                if (
+                    isinstance(node.value, ast.Call)
+                    and last_segment(node.value.func) in _DECODE_CALLS
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            decoded_names.add(tgt.id)
+        # Pass 2: encode-family consumers + codec bounds.
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            if seg in _ENCODE_CALLS:
+                bad = self._decoded_arg(node, decoded_names)
+                if bad is not None:
+                    self.report(
+                        node,
+                        f"encode call {seg}() consumes a decode-family "
+                        "result inside a wire-path function — the "
+                        "decode-then-requantize temp materialises the "
+                        "full fp32 window between encode and send and "
+                        "erases the wire win; keep the payload encoded "
+                        "end to end (decode only at the landing/"
+                        "consumer edge)",
+                    )
+            bound = _CODEC_BOUNDS.get(seg)
+            if bound is not None and isinstance(node.func, ast.Attribute):
+                if not self._has_bound(node, bound):
+                    self.report(
+                        node,
+                        f"codec call {seg}() without an explicit "
+                        f"{bound}= bound in a wire-path function — "
+                        "encode levels drift with library defaults and "
+                        "an unbounded decode lets a corrupt length "
+                        "header balloon the allocator; pass "
+                        f"{bound}= explicitly",
+                    )
+
+    @staticmethod
+    def _decoded_arg(call: ast.Call, decoded: Set[str]) -> Optional[ast.AST]:
+        args: List[ast.AST] = list(call.args) + [
+            kw.value for kw in call.keywords
+        ]
+        for a in args:
+            if isinstance(a, ast.Call) and last_segment(a.func) in (
+                _DECODE_CALLS
+            ):
+                return a
+            if isinstance(a, ast.Name) and a.id in decoded:
+                return a
+        return None
+
+    @staticmethod
+    def _has_bound(call: ast.Call, bound: str) -> bool:
+        if any(kw.arg == bound for kw in call.keywords):
+            return True
+        return len(call.args) >= 2  # positional bound slot filled

@@ -45,6 +45,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL018",  # cluster loop with no deadline or lease-expiry check
     "DDL019",  # blocking wait inside a per-tenant serve loop
     "DDL020",  # host sync inside a fused compute/ingest step function
+    "DDL021",  # wire-path decode-then-requantize / unbounded codec call
 )
 
 
@@ -156,6 +157,22 @@ class LintConfig:
             "DistributedDataLoader._sweep_release_backlog",
             "IciDistributor._distribute_planned",
             "IciDistributor._track_in_flight",
+        ]
+    )
+    #: Wire-path functions (bare name or ``Class.method``): they sit
+    #: between a wire encode and the send.  A decode-family result
+    #: feeding an encode-family call (the decode-then-requantize temp)
+    #: or a codec call without its explicit ``level``/``max_output``
+    #: bound is DDL021.
+    wire_path_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "DataPusher._encode_and_commit",
+            "ThreadExchangeShuffler._encode_lane",
+            "ThreadExchangeShuffler._decode_lane",
+            "IciDistributor._distribute_planned",
+            "CodecBackend.open",
+            "pack_rows",
+            "unpack_rows",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
