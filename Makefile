@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -90,9 +90,10 @@ check: lint bench-smoke
 # Chaos suite: deterministic fault matrix + randomized multi-fault soak
 # (includes slow PROCESS-mode spawns; docs/ROBUSTNESS.md) + the cache
 # corruption/backend-failure ladder (tests/test_cache.py) + the ICI
-# DMA-failure → xla-fallback rung (tests/test_ici.py).
+# DMA-failure → xla-fallback rung (tests/test_ici.py) + the preemption
+# notice/checkpoint-corruption rows (tests/test_resilience.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py tests/test_resilience.py -q
 
 # Distributed-optimizer suite alone (parity matrix, collective units,
 # the 4B fits-only-with-zero1 accounting test).
@@ -116,3 +117,15 @@ wire-dryrun:
 # slot/exchange/ICI wire paths, the wire chaos rows).
 wire-test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_wire.py -q
+
+# Preemption-tolerance suite alone (async checkpointer units, the
+# restore quarantine/fallback ladder, revocation, SIGTERM/notice drain
+# e2e in THREAD and forced-py-ring PROCESS mode; docs/ROBUSTNESS.md).
+preempt-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q
+
+# Preemption tolerance priced end to end: async-vs-sync checkpoint
+# stall A/B, notice→resumed recovery wall time, hard-kill lost-work
+# bound — byte-identical resume asserted in the artifact.
+preempt-bench:
+	DDL_BENCH_MODE=preempt JAX_PLATFORMS=cpu $(PY) bench.py

@@ -1402,6 +1402,216 @@ class _ThrottledRendezvous:
         self.inner.retire(key)
 
 
+def _run_preempt_ab() -> dict:
+    """Preemption tolerance priced end to end (ISSUE 14).
+
+    Three legs over one small deterministic window-stream geometry
+    (pointnet, 4 steps/window — checkpoint cost, not model cost, is
+    the thing measured):
+
+    1. **Checkpoint-stall A/B** (measured, interleaved): the same fit
+       checkpointing EVERY window through the synchronous Orbax path
+       (``checkpoint_async=False`` — the fit stalls for serialize +
+       fsync + rename) vs the async tier (the stall is the D2H
+       snapshot alone; the write hides under training).  Published
+       per-checkpoint stalls are each rep-median; the headline is the
+       sync/async stall reduction.
+    2. **Notice → resumed recovery** (deterministic): a seeded
+       ``PREEMPT_NOTICE`` lands mid-run through the real
+       ``resilience.notice`` chaos site, the guard drains (forced
+       final checkpoint), and a fresh trainer resumes —
+       ``recovery_wall_s`` = measured drain + restore-to-first-window
+       time, with the resumed window stream BYTE-IDENTICAL and the
+       loss curve bit-exact vs the uninterrupted reference.
+    3. **Hard-kill lost-work bound** (deterministic): a run that dies
+       with NO drain (its newest durable checkpoint one interval old)
+       resumes losing exactly the windows since that checkpoint —
+       ``lost_steps <= ckpt_interval * steps_per_window`` asserted in
+       the block, with the replayed tail byte-identical too.
+    """
+    import tempfile
+    import zlib as _zlib
+
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu import faults
+    from ddl_tpu.faults import FaultKind, FaultPlan, FaultSpec
+    from ddl_tpu.models import pointnet
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.parallel.mesh import make_mesh
+    from ddl_tpu.readers import ArrayProducer
+    from ddl_tpu.resilience import PreemptionGuard
+    from ddl_tpu.trainer import Trainer
+
+    import jax
+
+    cfg = pointnet.PointNetConfig(n_inputs=3, n_outputs=2)
+    mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
+    seed, batch, window = 1234, 16, 64
+    n_windows, interval, notice_at = 6, 2, 5
+    bpw = window // batch  # steps per window
+
+    def producer():
+        data = np.random.default_rng(seed).random((256, 6)).astype(
+            np.float32
+        )
+        return ArrayProducer(data, window_size=window, splits=(3, 2, 1))
+
+    def make_trainer(ckpt_dir, metrics, every=1, **kw):
+        return Trainer(
+            loss_fn=lambda p, b: pointnet.weighted_mse_loss(p, b, cfg),
+            optimizer=optax.adam(1e-2),
+            mesh=mesh,
+            param_specs=pointnet.param_specs(cfg),
+            init_params=pointnet.init_params(cfg, jax.random.key(0)),
+            batch_spec=P(("dp",)),
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every_epochs=every,
+            watchdog=False,
+            metrics=metrics,
+            **kw,
+        )
+
+    def run(trainer, n, crcs=None):
+        def hook(win):
+            if crcs is not None:
+                crcs.append(_zlib.crc32(np.asarray(win).tobytes()))
+            return win
+
+        return trainer.fit(
+            producer(), batch_size=batch, n_epochs=n, n_producers=2,
+            mode="thread", output="jax", window_stream=True,
+            window_hook=hook,
+        )
+
+    base = tempfile.mkdtemp(prefix="ddl-preempt-")
+
+    # -- leg 1: per-checkpoint stall, sync vs async, interleaved -------
+    def stall_rep(i):
+        m_async, m_sync = Metrics(), Metrics()
+        run(make_trainer(
+            os.path.join(base, f"a{i}"), m_async, checkpoint_async=True,
+        ), n_windows)
+        run(make_trainer(
+            os.path.join(base, f"s{i}"), m_sync, checkpoint_async=False,
+        ), n_windows)
+        ta = m_async.timer("resilience.ckpt_submit")
+        ts = m_sync.timer("resilience.ckpt_sync")
+        if not ta.count or not ts.count:
+            raise RuntimeError("checkpoint timers never ticked")
+        return ta.total_s / ta.count, ts.total_s / ts.count, ta.count
+
+    reps = [stall_rep(i) for i in range(3)]
+    asyncs = sorted(r[0] for r in reps)
+    syncs = sorted(r[1] for r in reps)
+    async_stall = asyncs[len(asyncs) // 2]
+    sync_stall = syncs[len(syncs) // 2]
+
+    # -- leg 2: notice → drain → byte-identical resume -----------------
+    m_ref = Metrics()
+    crcs_ref: list = []
+    ref = run(
+        make_trainer(os.path.join(base, "ref"), m_ref, every=interval),
+        n_windows, crcs=crcs_ref,
+    )
+    m_b = Metrics()
+    guard = PreemptionGuard(deadline_s=30.0, metrics=m_b)
+    plan = FaultPlan([
+        FaultSpec("resilience.notice", FaultKind.PREEMPT_NOTICE,
+                  at=notice_at),
+    ])
+    crcs_b: list = []
+    drain_dir = os.path.join(base, "drain")
+    with faults.armed(plan):
+        res_b = run(
+            make_trainer(drain_dir, m_b, every=interval,
+                         preemption_guard=guard),
+            n_windows, crcs=crcs_b,
+        )
+    if not res_b.preempted:
+        raise RuntimeError("injected preemption notice never drained")
+    drain_s = m_b.timer("resilience.drain").total_s
+    m_c = Metrics()
+    crcs_c: list = []
+    first_window_t: list = []
+    t0 = time.perf_counter()
+
+    def resume_hook(win):
+        if not first_window_t:
+            first_window_t.append(time.perf_counter() - t0)
+        crcs_c.append(_zlib.crc32(np.asarray(win).tobytes()))
+        return win
+
+    t_resume = make_trainer(drain_dir, m_c, every=interval)
+    res_c = t_resume.fit(
+        producer(), batch_size=batch, n_epochs=n_windows, n_producers=2,
+        mode="thread", output="jax", window_stream=True,
+        window_hook=resume_hook,
+    )
+    recovery_wall_s = drain_s + (
+        first_window_t[0] if first_window_t else float("nan")
+    )
+    drained_identical = (
+        crcs_b + crcs_c == crcs_ref
+        and res_b.losses + res_c.losses == ref.losses
+        and res_c.state.step == ref.state.step
+    )
+
+    # -- leg 3: hard kill (no drain) — the lost-work bound -------------
+    kill_dir = os.path.join(base, "kill")
+    m_d = Metrics()
+    run(make_trainer(kill_dir, m_d, every=interval), notice_at)
+    # The run "died" at window `notice_at` with NO final checkpoint:
+    # the newest durable generation is the last interval multiple.
+    m_e = Metrics()
+    crcs_e: list = []
+    res_e = run(
+        make_trainer(kill_dir, m_e, every=interval), n_windows,
+        crcs=crcs_e,
+    )
+    resumed_from = res_e.resumed_from_epoch
+    lost_windows = notice_at - resumed_from
+    kill_identical = (
+        crcs_e == crcs_ref[resumed_from:]
+        and res_e.losses == ref.losses[resumed_from:]
+    )
+    if lost_windows * bpw > interval * bpw:
+        raise RuntimeError(
+            f"lost {lost_windows} windows > checkpoint interval "
+            f"{interval} — the durability bound is broken"
+        )
+
+    return {
+        "sync_ckpt_stall_s": round(sync_stall, 6),
+        "async_ckpt_stall_s": round(async_stall, 6),
+        "async_vs_sync": round(async_stall / sync_stall, 4),
+        "stall_reduction": round(sync_stall / max(async_stall, 1e-9), 2),
+        "checkpoints": int(reps[0][2]),
+        "ckpt_interval_windows": interval,
+        "steps_per_window": bpw,
+        "windows": n_windows,
+        "notice_window": notice_at,
+        "drain_s": round(drain_s, 4),
+        "drain_deadline_s": guard.deadline_s,
+        "drained_within_deadline": bool(
+            m_b.gauge("resilience.drain_within_deadline")
+        ),
+        "notices": m_b.counter("resilience.notices"),
+        "final_ckpts": m_b.counter("resilience.final_ckpts"),
+        "recovery_wall_s": round(recovery_wall_s, 4),
+        "resumed_from_window": res_c.resumed_from_epoch,
+        "hard_kill_resumed_from": resumed_from,
+        "lost_steps": lost_windows * bpw,
+        "lost_steps_bound": interval * bpw,
+        "byte_identical": bool(drained_identical and kill_identical),
+        "loss_bitexact": bool(
+            res_b.losses + res_c.losses == ref.losses
+            and res_e.losses == ref.losses[resumed_from:]
+        ),
+    }
+
+
 def _run_wire_ab() -> dict:
     """Raw vs quantized vs compressed exchange wire over a throttled
     link (ISSUE 13, ROADMAP item 3).
@@ -2620,6 +2830,27 @@ def main() -> None:
             result["headline_config"] = result["wire"]["winner"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["wire"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "preempt":
+        # `make preempt-bench`: preemption tolerance priced end to end
+        # (ISSUE 14) — async-vs-sync per-checkpoint stall (interleaved
+        # A/B; the headline is the stall reduction), notice→resumed
+        # recovery wall time through the real chaos site + guard, and
+        # the hard-kill lost-work bound (steps lost <= checkpoint
+        # interval), with the resumed streams byte-identical and loss
+        # curves bit-exact (bench_smoke enforces the block).
+        result["metric"] = "ckpt_stall_reduction"
+        result["unit"] = "x"
+        try:
+            result["preempt"] = _run_preempt_ab()
+            result["value"] = result["preempt"]["stall_reduction"]
+            result["headline_config"] = "async"
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["preempt"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

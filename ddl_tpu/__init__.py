@@ -31,6 +31,7 @@ __all__ = [
     "Trainer",
     "cluster",
     "distributed_dataloader",
+    "resilience",
 ]
 
 
@@ -54,4 +55,10 @@ def __getattr__(name: str):
         import ddl_tpu.cluster as cluster
 
         return cluster
+    if name == "resilience":
+        # Preemption-tolerant training (async integrity-checked
+        # checkpoints, graceful drain-on-notice, verified restore).
+        import ddl_tpu.resilience as resilience
+
+        return resilience
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1029,29 +1029,54 @@ class DistributedDataLoader:
 
     def _acquire_verified(self, target: int, ahead: int, timeout_s: float):
         """Acquire the next committed slot on ``target`` and verify its
-        integrity header.  A corrupt head slot (``ahead == 0``) enters
-        quarantine-and-replay; corruption discovered during lookahead
-        deepening (``ahead > 0``) raises :class:`_CorruptAhead` — held
-        slots make out-of-FIFO quarantine impossible, so the caller
-        stops deepening and the window re-verifies when it reaches the
-        head."""
+        integrity header — behind the fair-share admission gate when a
+        tenant is bound (``bind_admission``).
+
+        Admission runs FIRST (ddl_tpu.serve): no ring wait may start
+        before the tenant's turn is granted — otherwise a slot could be
+        held hostage while the scheduler throttles the holder.
+        Non-blocking probes (``timeout_s <= 0``) raise
+        :class:`StallTimeoutError` when not grantable, which the
+        lookahead deepening treats as "not committed yet".  The
+        admission wait SPENDS FROM the same budget the ring acquire
+        gets: one acquisition, one ``timeout_s`` — a throttled tenant
+        must not silently double the documented stall budget.  A grant
+        whose ring acquire then FAILS (stall timeout, revoked target,
+        shutdown) is released via ``note_aborted`` — a leaked in-flight
+        grant would make every later ``revoke_inflight`` burn its full
+        SLO on a phantom window.
+        """
+        if self._admission is None:
+            return self._acquire_slot_verified(target, ahead, timeout_s)
+        t_admit = time.monotonic()
+        self._admission.admit(timeout_s)
+        if timeout_s > 0:
+            timeout_s = max(0.0, timeout_s - (time.monotonic() - t_admit))
+        try:
+            slot = self._acquire_slot_verified(target, ahead, timeout_s)
+        except BaseException:
+            abort = getattr(self._admission, "note_aborted", None)
+            if abort is not None:
+                abort()
+            raise
+        # The charge-after half of the fair-share gate: the window's
+        # actual byte size is only known post-acquire.
+        self._admission.note_served(
+            int(self.connection.rings[target].slot_payload(slot))
+        )
+        return slot
+
+    def _acquire_slot_verified(
+        self, target: int, ahead: int, timeout_s: float
+    ):
+        """The admission-free acquire: next committed slot on
+        ``target``, integrity-verified.  A corrupt head slot (``ahead
+        == 0``) enters quarantine-and-replay; corruption discovered
+        during lookahead deepening (``ahead > 0``) raises
+        :class:`_CorruptAhead` — held slots make out-of-FIFO quarantine
+        impossible, so the caller stops deepening and the window
+        re-verifies when it reaches the head."""
         ring = self.connection.rings[target]
-        if self._admission is not None:
-            # Fair-share admission first (ddl_tpu.serve): no ring wait
-            # may start before the tenant's turn is granted — otherwise
-            # a slot could be held hostage while the scheduler throttles
-            # the holder.  Non-blocking probes (timeout_s <= 0) raise
-            # StallTimeoutError when not grantable, which the lookahead
-            # deepening treats as "not committed yet".  The admission
-            # wait SPENDS FROM the same budget the ring acquire gets:
-            # one acquisition, one timeout_s — a throttled tenant must
-            # not silently double the documented stall budget.
-            t_admit = time.monotonic()
-            self._admission.admit(timeout_s)
-            if timeout_s > 0:
-                timeout_s = max(
-                    0.0, timeout_s - (time.monotonic() - t_admit)
-                )
         pool_managed = (
             self._cluster is not None
             or self._pool is not None
@@ -1109,10 +1134,6 @@ class DistributedDataLoader:
                 slot = self._quarantine_and_replay(
                     target, expect, err, timeout_s
                 )
-        if self._admission is not None:
-            # The charge-after half of the fair-share gate: the
-            # window's actual byte size is only known post-acquire.
-            self._admission.note_served(int(ring.slot_payload(slot)))
         return slot
 
     def _quarantine_and_replay(

@@ -141,6 +141,49 @@ class TenantBurst(DDLError):
         super().__init__(message)
 
 
+class PreemptionNotice(DDLError):
+    """The platform announced this host's imminent preemption (the
+    ``PREEMPT_NOTICE`` fault kind at ``resilience.notice``, a SIGTERM
+    delivered to the trainer, or the ``DDL_TPU_PREEMPT_NOTICE`` env
+    knob an operator/agent sets).
+
+    Carries ``deadline_s`` — the grace budget the notice grants — when
+    the raiser knows it.  The :class:`~ddl_tpu.resilience.
+    PreemptionGuard` absorbs it at window boundaries and turns it into
+    a deadline-bounded graceful drain (forced final checkpoint,
+    in-flight tenant-window revocation, graceful host drain); it never
+    escapes a guarded ``Trainer.fit``.
+    """
+
+    def __init__(self, message: str = "", deadline_s: float = 0.0):
+        self.deadline_s = float(deadline_s)
+        super().__init__(message)
+
+
+class WindowsRevoked(StallTimeoutError):
+    """A tenant's in-flight window grants were revoked under a drain
+    SLO (``FairShareScheduler.revoke_inflight`` — the scale-down /
+    preemption rung that stops waiting for tenant idleness).
+
+    Subclasses :class:`StallTimeoutError` deliberately: a revoked
+    admission wait surfaces through the loader's one acquire choke
+    point exactly like a stall deadline (non-blocking deepening probes
+    already treat it as not-committed-yet), while staying catchable as
+    its own type so a tenant runtime can distinguish "you were
+    preempted" from "the ring wedged".
+    """
+
+
+class CheckpointError(DDLError):
+    """A checkpoint could not be durably written or flushed
+    (``ddl_tpu.resilience``): the async writer's final forced flush
+    failed, or a generation write raised past its retry.  Restore-side
+    corruption is NOT this error — unverifiable generations are
+    quarantined and skipped (cold start at exhaustion, with a loud
+    counter), never raised to the trainer.
+    """
+
+
 class InjectedFault(DDLError):
     """A deliberate failure raised by the fault-injection engine.
 

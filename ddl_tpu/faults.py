@@ -94,6 +94,21 @@ Injection points shipped today (site — fault kinds that act there):
                           each path's ladder: bounded retry, then the
                           raw fallback (``wire.fallbacks``) or the
                           backend retry/refetch rung
+``resilience.notice``     polled by ``PreemptionGuard.poll`` once per
+                          window boundary: ``PREEMPT_NOTICE`` raises
+                          the real ``PreemptionNotice`` (``param`` =
+                          grace seconds, 0 = guard default) — the
+                          deterministic analog of a TPU spot
+                          preemption SIGTERM, driving the full
+                          graceful-drain ladder
+``resilience.ckpt_write`` inside ``AsyncCheckpointer``'s writer thread
+                          on the fully CRC-stamped generation blob,
+                          just before the atomic write —
+                          ``CKPT_CORRUPTION`` flips committed bytes so
+                          the written generation fails read-time
+                          verification: quarantine + fallback to the
+                          previous verified generation is what the
+                          injection exercises
 ========================  ====================================================
 """
 
@@ -139,6 +154,8 @@ class FaultKind(enum.Enum):
     SCALE_DECISION_DELAY = "scale_decision_delay"
     WIRE_CORRUPTION = "wire_corruption"
     DECODE_FAIL = "decode_fail"
+    PREEMPT_NOTICE = "preempt_notice"
+    CKPT_CORRUPTION = "ckpt_corruption"
 
 
 @dataclasses.dataclass
@@ -280,6 +297,7 @@ class FaultPlan:
             FaultKind.RING_CORRUPTION,
             FaultKind.CACHE_CORRUPTION,
             FaultKind.WIRE_CORRUPTION,
+            FaultKind.CKPT_CORRUPTION,
         ):
             if view is None or len(view) == 0:
                 return  # site carries no mutable payload; nothing to flip
@@ -327,6 +345,16 @@ class FaultPlan:
             from ddl_tpu.exceptions import DecodeError
 
             raise DecodeError(f"decode failure {where}")
+        elif kind is FaultKind.PREEMPT_NOTICE:
+            # The real type (the BACKEND_FETCH_FAIL pattern): the
+            # PreemptionGuard's poll absorbs it and runs the production
+            # graceful-drain ladder — exactly what a platform SIGTERM
+            # drives.  ``param`` carries the notice's grace seconds.
+            from ddl_tpu.exceptions import PreemptionNotice
+
+            raise PreemptionNotice(
+                f"preemption notice {where}", deadline_s=spec.param
+            )
         elif kind is FaultKind.SHUFFLE_PEER_LOSS:
             raise DDLError(f"shuffle peer loss {where}")
         else:  # pragma: no cover - FaultKind is closed above

@@ -594,6 +594,31 @@ def north_star_report(
     report["wire_decoded_windows"] = m.counter("wire.decoded_windows")
     report["wire_decode_fails"] = m.counter("wire.decode_fails")
     report["wire_fallbacks"] = m.counter("wire.fallbacks")
+    # Preemption tolerance (ISSUE 14: ddl_tpu.resilience): notices
+    # absorbed and drains run, the async checkpoint tier's hot-path
+    # stall (the submit timer — the ONLY stall the step loop pays) vs
+    # its hidden write time, and the restore ladder's health
+    # (quarantined generations / cold starts are incidents the BENCH_*
+    # trajectories must chart even when the run "passed").  The
+    # revocation counter is the serve-plane half of the drain ladder.
+    report["resilience_notices"] = m.counter("resilience.notices")
+    report["resilience_drains"] = m.counter("resilience.drains")
+    report["resilience_drain_s"] = m.timer("resilience.drain").total_s
+    report["resilience_ckpts"] = m.counter("resilience.ckpts")
+    report["resilience_final_ckpts"] = m.counter("resilience.final_ckpts")
+    report["resilience_ckpt_submit_s"] = m.timer(
+        "resilience.ckpt_submit"
+    ).total_s
+    report["resilience_ckpt_write_s"] = m.timer(
+        "resilience.ckpt_write"
+    ).total_s
+    report["resilience_ckpt_quarantined"] = m.counter(
+        "resilience.ckpt_quarantined"
+    )
+    report["resilience_ckpt_cold_starts"] = m.counter(
+        "resilience.ckpt_cold_starts"
+    )
+    report["serve_revocations"] = m.counter("serve.revocations")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

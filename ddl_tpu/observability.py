@@ -64,7 +64,19 @@ producer.* one: slot-path decode counters are CONSUMER-side and
 surface in every mode, while the exchange wire's ladder events count
 in the shuffler's own registry — shared with the consumer in THREAD
 mode, per worker process in PROCESS mode, where the raw-latch also
-logs at ERROR).
+logs at ERROR), and ``resilience.*`` (preemption tolerance,
+``ddl_tpu.resilience`` — the ``notices``/``drains``/``final_ckpts``
+drain-ladder counters with the ``resilience.drain`` timer and the
+``drain_within_deadline`` gauge, the async checkpoint tier's
+``ckpts``/``ckpt_skipped``/``ckpt_retired``/``ckpt_write_failures``
+counters with the ``ckpt_submit`` (hot-path stall) vs ``ckpt_write``
+(hidden) timer split and the ``ckpt_bytes`` gauge, the restore
+ladder's ``ckpt_restores``/``ckpt_quarantined``/``ckpt_unverified``/
+``ckpt_cold_starts`` counters, plus the legacy synchronous path's
+``ckpt_sync`` timer; the serve-plane revocation rung rides
+``serve.revocations``/``serve.revoked_waiters``/
+``serve.revoked_inflight`` and per-tenant
+``ingest.<tenant>.revocations``).
 """
 
 from __future__ import annotations

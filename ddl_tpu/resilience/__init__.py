@@ -1,0 +1,51 @@
+"""ddl_tpu.resilience — preemption-tolerant training (ISSUE 14).
+
+Trainer-side fault tolerance closing the loop from preemption notice
+to byte-identical resume:
+
+- :class:`AsyncCheckpointer` — background-thread generation
+  checkpoints (atomic temp+rename, integrity-trailer stamped,
+  step-derived seq, keep-K retention, loader cursor fenced into the
+  same blob) whose hot-path stall is the D2H snapshot alone.
+- :class:`PreemptionGuard` — SIGTERM / ``DDL_TPU_PREEMPT_NOTICE`` /
+  chaos-site notices turned into a deadline-bounded graceful drain
+  (forced checkpoint → tenant-window revocation → graceful host drain
+  → clean producer shutdown).
+- The restore ladder — :func:`latest_verified_generation` /
+  :func:`restore_latest`: unverifiable generations quarantined
+  (``.quarantined``) and skipped, fallback to the previous verified
+  generation, cold start (loud counter) at exhaustion.
+
+docs/ROBUSTNESS.md has the failure model; docs/DEPLOY.md the
+"surviving TPU preemption" recipe.
+"""
+
+from ddl_tpu.resilience.ckpt import (
+    AsyncCheckpointer,
+    RestoredRun,
+    latest_verified_generation,
+    list_generations,
+    restore_latest,
+    serialize_generation,
+    verify_generation,
+)
+from ddl_tpu.resilience.guard import (
+    DEADLINE_ENV,
+    DEFAULT_DEADLINE_S,
+    NOTICE_ENV,
+    PreemptionGuard,
+)
+
+__all__ = [
+    "AsyncCheckpointer",
+    "DEADLINE_ENV",
+    "DEFAULT_DEADLINE_S",
+    "NOTICE_ENV",
+    "PreemptionGuard",
+    "RestoredRun",
+    "latest_verified_generation",
+    "list_generations",
+    "restore_latest",
+    "serialize_generation",
+    "verify_generation",
+]

@@ -1,0 +1,302 @@
+"""Preemption notice → deadline-bounded graceful drain (ISSUE 14).
+
+TPU preemption is the dominant production failure mode on
+spot/preemptible pods: the platform delivers a SIGTERM (or an agent
+sets an env knob) and the job has a fixed grace budget — typically
+30-60 s — before the hard kill.  :class:`PreemptionGuard` turns that
+notice into an ordered drain the trainer runs at its next window
+boundary:
+
+1. **Forced final checkpoint** — the async checkpointer's
+   :meth:`~ddl_tpu.resilience.ckpt.AsyncCheckpointer.checkpoint_now`
+   (train state + fenced loader cursor, durably written), so the
+   restarted job loses ZERO steps instead of up to one interval.
+   Always attempted first: the checkpoint is the rung that bounds lost
+   work; everything after it is cluster hygiene.
+2. **In-flight tenant-window revocation** — ``revoke_inflight`` through
+   the admission seam (:mod:`ddl_tpu.serve`): active tenants' granted
+   and waiting window acquisitions on this host are revoked under a
+   per-tenant SLO (size it from the p99 window latency the tenancy
+   bench measures) instead of waiting for idleness — the ROADMAP 1(c)
+   rung.  Revoked waiters raise the typed
+   :class:`~ddl_tpu.exceptions.WindowsRevoked`.
+3. **Graceful host drain** — ``ElasticCluster.drain_host`` for the
+   departing host: the epoch-fenced view change re-partitions its
+   shards onto survivors and parks its producers as warm standby.
+4. **Clean producer shutdown** — the loader's shutdown, so rings close
+   and the watchdog records zero failures (a drain is not a fault).
+
+Every rung is bounded by the remaining grace budget; a rung whose turn
+comes after the deadline is SKIPPED with a loud counter (the
+checkpoint, first in line, is the one that practically never is).
+
+Notice sources (any of): a SIGTERM handler (:meth:`install` — the
+production path), the ``DDL_TPU_PREEMPT_NOTICE`` env knob (operator /
+agent; optionally carrying the grace seconds as its value), a
+programmatic :meth:`notify`, or the ``resilience.notice`` chaos site
+(``PREEMPT_NOTICE`` raises the real
+:class:`~ddl_tpu.exceptions.PreemptionNotice`, which :meth:`poll`
+absorbs — deterministic preemption for the chaos matrix).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ddl_tpu.exceptions import (
+    CheckpointError,
+    DDLError,
+    PreemptionNotice,
+    ShutdownRequested,
+)
+from ddl_tpu.faults import fault_point
+from ddl_tpu.observability import Metrics, metrics as default_metrics
+
+logger = logging.getLogger("ddl_tpu")
+
+#: Env knob: any truthy value is a standing preemption notice; a float
+#: value overrides the grace budget (seconds).
+NOTICE_ENV = "DDL_TPU_PREEMPT_NOTICE"
+#: Env knob: default grace budget when the notice carries none.
+DEADLINE_ENV = "DDL_TPU_PREEMPT_DEADLINE_S"
+#: Fallback grace budget (the common TPU spot notice is 30 s).
+DEFAULT_DEADLINE_S = 30.0
+
+
+class PreemptionGuard:
+    """One training run's preemption handler.
+
+    Construct it with whatever drain rungs exist in the deployment —
+    a bench that only wants checkpoint-on-SIGTERM attaches nothing;
+    the full serving stack attaches the admission controller and the
+    elastic cluster::
+
+        guard = PreemptionGuard(cluster=elastic, host_id=my_host,
+                                admission=controller)
+        trainer = Trainer(..., preemption_guard=guard)
+        with guard:                       # installs the SIGTERM handler
+            res = trainer.fit(...)
+        if res.preempted:
+            ...                           # exit; restart resumes
+
+    Thread-safe: the signal handler / a watcher thread may
+    :meth:`notify` while the trainer polls at window boundaries.
+    """
+
+    def __init__(
+        self,
+        deadline_s: Optional[float] = None,
+        cluster: Any = None,
+        host_id: Optional[int] = None,
+        admission: Any = None,
+        revoke_slo_s: float = 1.0,
+        metrics: Optional[Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if deadline_s is None:
+            deadline_s = float(
+                os.environ.get(DEADLINE_ENV, DEFAULT_DEADLINE_S)
+            )
+        if deadline_s <= 0:
+            raise DDLError(
+                f"preemption deadline must be > 0, got {deadline_s}"
+            )
+        self.deadline_s = float(deadline_s)
+        self.cluster = cluster
+        self.host_id = host_id
+        self.admission = admission
+        self.revoke_slo_s = float(revoke_slo_s)
+        self.metrics = metrics or default_metrics()
+        self._clock = clock
+        # REENTRANT: the SIGTERM handler runs on the MAIN thread between
+        # bytecodes — with a plain Lock, a signal landing while that
+        # same thread holds it (remaining() is called from every drain
+        # rung) would deadlock notify() against its own frame.
+        self._lock = threading.RLock()
+        self._notice_t: Optional[float] = None
+        self._reason = ""
+        self._drained = False
+        self._prev_handler: Any = None
+        self._installed = False
+
+    # -- notice sources ----------------------------------------------------
+
+    def install(self) -> "PreemptionGuard":
+        """Install the SIGTERM handler (main thread only — elsewhere
+        the signal module refuses; the env/programmatic sources still
+        work, logged)."""
+        try:
+            self._prev_handler = signal.signal(
+                signal.SIGTERM, self._on_sigterm
+            )
+            self._installed = True
+        except ValueError:
+            logger.warning(
+                "resilience: SIGTERM handler not installed (not the "
+                "main thread) — env/programmatic notice still observed"
+            )
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev_handler)
+            self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
+
+    def _on_sigterm(self, signum: int, frame: Any) -> None:
+        # Async-signal-safe enough: set the flag; the trainer drains at
+        # its next window boundary.
+        self.notify("SIGTERM")
+
+    def notify(
+        self, reason: str = "", deadline_s: Optional[float] = None
+    ) -> None:
+        """Record a preemption notice (first one wins; duplicates are
+        absorbed).  ``deadline_s`` overrides the grace budget when the
+        notice carries its own."""
+        with self._lock:
+            if self._notice_t is not None:
+                return
+            self._notice_t = self._clock()
+            self._reason = reason or "notice"
+            if deadline_s is not None and deadline_s > 0:
+                self.deadline_s = float(deadline_s)
+        self.metrics.incr("resilience.notices")
+        logger.warning(
+            "resilience: preemption notice (%s) — graceful drain within "
+            "%.1fs at the next window boundary",
+            self._reason, self.deadline_s,
+        )
+
+    def poll(self) -> bool:
+        """The trainer's once-per-window-boundary check: True once a
+        notice is pending (signal, env knob, chaos site, or a prior
+        :meth:`notify`)."""
+        if self._notice_t is not None:
+            return True
+        try:
+            # Chaos site: PREEMPT_NOTICE raises the real type below.
+            fault_point("resilience.notice")
+        except PreemptionNotice as n:
+            self.notify("injected", deadline_s=n.deadline_s or None)
+            return True
+        env = os.environ.get(NOTICE_ENV, "")
+        if env and env.lower() not in ("0", "off", "false"):
+            try:
+                deadline = float(env)
+            except ValueError:
+                deadline = None
+            self.notify(f"{NOTICE_ENV}={env}", deadline_s=deadline)
+            return True
+        return False
+
+    @property
+    def pending(self) -> bool:
+        return self._notice_t is not None
+
+    @property
+    def drained(self) -> bool:
+        return self._drained
+
+    def remaining(self) -> float:
+        """Grace budget left (seconds); the full budget before notice."""
+        with self._lock:
+            if self._notice_t is None:
+                return self.deadline_s
+            return max(
+                0.0, self.deadline_s - (self._clock() - self._notice_t)
+            )
+
+    # -- the drain ladder --------------------------------------------------
+
+    def drain(
+        self,
+        final_checkpoint: Optional[Callable[[], None]] = None,
+        shutdown: Optional[Callable[[], None]] = None,
+    ) -> bool:
+        """Run the drain ladder under the remaining grace budget.
+
+        ``final_checkpoint`` (the trainer's forced-checkpoint thunk)
+        runs FIRST and is the only rung attempted even at a blown
+        deadline — it bounds lost work; the cluster rungs are hygiene a
+        restart can survive skipping.  Returns True when every
+        applicable rung completed inside the budget.
+        """
+        t0 = self._clock()
+        self.metrics.incr("resilience.drains")
+        within = True
+        if final_checkpoint is not None:
+            try:
+                final_checkpoint()
+            except CheckpointError:
+                logger.exception(
+                    "resilience: forced final checkpoint FAILED — the "
+                    "restart resumes from the previous generation"
+                )
+                self.metrics.incr("resilience.final_ckpt_failures")
+        within &= self._rung(
+            "revoke_inflight",
+            self._revoke_rung if self.admission is not None else None,
+        )
+        within &= self._rung(
+            "drain_host",
+            self._drain_host_rung
+            if self.cluster is not None and self.host_id is not None
+            else None,
+        )
+        within &= self._rung("shutdown", shutdown)
+        dt = self._clock() - t0
+        self.metrics.add_time("resilience.drain", dt)
+        within = within and self.remaining() > 0
+        self.metrics.set_gauge(
+            "resilience.drain_within_deadline", 1.0 if within else 0.0
+        )
+        self._drained = True
+        logger.warning(
+            "resilience: drain complete in %.2fs (%s the %.1fs budget)",
+            dt, "within" if within else "OVER", self.deadline_s,
+        )
+        return within
+
+    def _rung(
+        self, name: str, action: Optional[Callable[[], None]]
+    ) -> bool:
+        if action is None:
+            return True
+        if self.remaining() <= 0:
+            self.metrics.incr("resilience.drain_rungs_skipped")
+            logger.error(
+                "resilience: drain rung %r SKIPPED — grace budget "
+                "exhausted", name,
+            )
+            return False
+        try:
+            action()
+        except (ShutdownRequested, KeyboardInterrupt):
+            raise
+        except Exception:
+            # ANY failed hygiene rung must never abort the drain: the
+            # checkpoint already landed and the restart recovers — an
+            # AttributeError out of a half-torn-down loader is exactly
+            # as survivable as a typed DDLError here.
+            logger.exception("resilience: drain rung %r failed", name)
+            self.metrics.incr("resilience.drain_rung_failures")
+        return True
+
+    def _revoke_rung(self) -> None:
+        slo = min(self.revoke_slo_s, max(0.0, self.remaining()))
+        self.admission.revoke_inflight(slo)
+        self.metrics.incr("resilience.revocations")
+
+    def _drain_host_rung(self) -> None:
+        self.cluster.drain_host(self.host_id)

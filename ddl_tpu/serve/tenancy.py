@@ -56,7 +56,12 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from ddl_tpu.exceptions import DDLError, StallTimeoutError, TenantBurst
+from ddl_tpu.exceptions import (
+    DDLError,
+    StallTimeoutError,
+    TenantBurst,
+    WindowsRevoked,
+)
 from ddl_tpu.faults import fault_point
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 
@@ -107,6 +112,11 @@ class _TenantState:
         self.stamp = now
         self.served_in_round = 0
         self.waiting = 0
+        # Preemption/scale-down seam (ISSUE 14): windows granted by
+        # admit() but not yet charged at note_served() — the in-flight
+        # set revoke_inflight waits out under its SLO.
+        self.inflight = 0
+        self.revoked = False
 
     def refill(self, now: float) -> None:
         rate = self.spec.byte_budget_per_s
@@ -192,8 +202,18 @@ class FairShareScheduler:
             st.waiting += 1
             try:
                 while True:
+                    if st.revoked:
+                        # Preemption/scale-down revocation (ISSUE 14):
+                        # the typed wake-up — never a silent timeout.
+                        self.metrics.incr("serve.revoked_waiters")
+                        self.metrics.incr(f"ingest.{name}.revocations")
+                        raise WindowsRevoked(
+                            f"tenant {name!r} admission revoked "
+                            "(preemption/scale-down drain in progress)"
+                        )
                     st.refill(self._clock())
                     if self._grantable(st):
+                        st.inflight += 1
                         break
                     if self._advance_round_if_stuck():
                         # Rounds replenish instantly (they are logical,
@@ -219,6 +239,18 @@ class FairShareScheduler:
         self.metrics.add_time("serve.admission_wait", wait)
         self.metrics.add_time(f"ingest.{name}.admission_wait", wait)
 
+    def note_aborted(self, name: str) -> None:
+        """Release a grant whose ring acquire FAILED (stall timeout,
+        revoked target, shutdown): the window was never served, so
+        nothing is charged — but the in-flight count must come back
+        down, or every later :meth:`revoke_inflight` would burn its
+        full SLO waiting on a phantom grant."""
+        with self._cond:
+            st = self._tenants.get(name)
+            if st is not None:
+                st.inflight = max(0, st.inflight - 1)
+                self._cond.notify_all()
+
     def note_served(self, name: str, nbytes: int) -> None:
         """Charge one served window against ``name``'s share + budgets
         (the charge-after half of :meth:`admit`)."""
@@ -232,9 +264,69 @@ class FairShareScheduler:
             if st.spec.byte_budget_per_s > 0:
                 st.tokens -= nbytes
             st.served_in_round += 1
+            st.inflight = max(0, st.inflight - 1)
             self._cond.notify_all()
         self.metrics.incr(f"ingest.{name}.bytes", float(nbytes))
         self.metrics.incr(f"ingest.{name}.windows")
+
+    # -- preemption / scale-down revocation (ISSUE 14) ---------------------
+
+    def revoke_inflight(
+        self, slo_s: float, names: "Optional[list] | None" = None
+    ) -> bool:
+        """Revoke active tenants' in-flight windows under an SLO —
+        the scale-down/preemption rung (ROADMAP 1(c)): instead of
+        waiting for tenant idleness, every waiting ``admit`` wakes with
+        the typed :class:`WindowsRevoked` and the already-GRANTED
+        windows (admit returned, ``note_served`` pending — at most one
+        per consumer thread, the DRR burst bound) are waited out for at
+        most ``slo_s`` seconds.  Size the SLO from the per-tenant p99
+        window latency the tenancy bench measures
+        (``per_tenant.<t>.p99_window_latency_s``): one p99 is the time
+        a granted window legitimately needs to finish its ring acquire.
+
+        ``names=None`` revokes every registered tenant (a whole-host
+        drain); a list narrows it.  Returns True when all revoked
+        in-flight windows completed inside the SLO.  Revoked tenants
+        stay refused until :meth:`clear_revocations` (rejoin).
+        """
+        deadline = self._clock() + max(0.0, slo_s)
+        with self._cond:
+            targets = [
+                st
+                for n, st in self._tenants.items()
+                if names is None or n in names
+            ]
+            for st in targets:
+                st.revoked = True
+            self._cond.notify_all()
+            # ONE bounded wait per pass (DDL019 shape): the fan-out
+            # above only flips flags; the SLO wait lives outside it.
+            while any(st.inflight > 0 for st in targets):
+                rem = deadline - self._clock()
+                if rem <= 0:
+                    break
+                self._cond.wait(min(0.05, rem))
+            leftover = sum(st.inflight for st in targets)
+        self.metrics.incr("serve.revocations")
+        if leftover:
+            self.metrics.incr("serve.revoked_inflight", float(leftover))
+            logger.warning(
+                "serve: %d in-flight window(s) still unfinished at the "
+                "%.2fs revocation SLO — proceeding with the drain",
+                leftover, slo_s,
+            )
+        return leftover == 0
+
+    def clear_revocations(
+        self, names: "Optional[list] | None" = None
+    ) -> None:
+        """Re-admit previously revoked tenants (the rejoin edge)."""
+        with self._cond:
+            for n, st in self._tenants.items():
+                if names is None or n in names:
+                    st.revoked = False
+            self._cond.notify_all()
 
     # -- internals (condition lock held) -----------------------------------
 
@@ -320,6 +412,18 @@ class Tenant:
     def note_served(self, nbytes: int) -> None:
         self.controller.scheduler.note_served(self.name, nbytes)
 
+    def note_aborted(self) -> None:
+        self.controller.scheduler.note_aborted(self.name)
+
+    def revoke_inflight(self, slo_s: float) -> bool:
+        """Revoke THIS tenant's in-flight windows under ``slo_s``."""
+        return self.controller.scheduler.revoke_inflight(
+            slo_s, names=[self.name]
+        )
+
+    def clear_revocations(self) -> None:
+        self.controller.scheduler.clear_revocations(names=[self.name])
+
     def bind(self, loader) -> "Tenant":
         """Attach this tenant's admission gate to a loader (and hand it
         the shared shard-cache tier's store for its producers via
@@ -374,6 +478,16 @@ class AdmissionController:
     def _release(self, name: str) -> None:
         self.scheduler.unregister(name)
         self._handles.pop(name, None)
+
+    def revoke_inflight(self, slo_s: float) -> bool:
+        """Revoke EVERY tenant's in-flight windows under ``slo_s`` —
+        the whole-host drain the :class:`~ddl_tpu.resilience.
+        PreemptionGuard` runs (ROADMAP 1(c)); see
+        :meth:`FairShareScheduler.revoke_inflight`."""
+        return self.scheduler.revoke_inflight(slo_s)
+
+    def clear_revocations(self) -> None:
+        self.scheduler.clear_revocations()
 
     def report(self) -> dict:
         """Per-tenant ``ingest.<t>.*`` blocks plus the ``serve.*``

@@ -75,6 +75,10 @@ class FitResult:
     epochs_run: int
     resumed_from_epoch: int
     metrics: Metrics
+    #: True when the run ended in a graceful preemption drain
+    #: (``ddl_tpu.resilience.PreemptionGuard``) rather than completing
+    #: its epochs — the caller should exit and let the restart resume.
+    preempted: bool = False
 
 
 class Trainer:
@@ -96,6 +100,9 @@ class Trainer:
         metrics: Optional[Metrics] = None,
         accum_steps: Optional[int] = None,
         train_config: Any = None,
+        checkpoint_async: Optional[bool] = None,
+        checkpoint_keep: int = 3,
+        preemption_guard: Any = None,
     ):
         """``loss_fn(params, batch) -> scalar`` over the loader's batch
         tuple; ``init_params`` is the initial params pytree (ignored when a
@@ -113,7 +120,22 @@ class Trainer:
         pipeline schedule apply where the model is BUILT
         (``train_config.model_config(cfg)`` /
         ``train_config.pipeline_kwargs()``), since the Trainer only
-        ever sees the closed-over ``loss_fn``."""
+        ever sees the closed-over ``loss_fn``.
+
+        ``checkpoint_async`` (default: the ``DDL_TPU_CKPT_ASYNC`` env
+        gate, on) routes checkpoints through
+        :class:`~ddl_tpu.resilience.AsyncCheckpointer` — the step
+        loop's stall is the D2H snapshot alone, generations carry
+        integrity trailers, and the loader cursor is fenced into the
+        same atomic blob; ``False`` keeps the legacy synchronous Orbax
+        path (now atomic temp+rename + manifest-verified on read).
+        ``checkpoint_keep`` is the async tier's keep-K retention.
+        ``preemption_guard`` (a :class:`~ddl_tpu.resilience.
+        PreemptionGuard`) is polled at every window/epoch boundary:
+        on a notice the run drains gracefully — forced final
+        checkpoint, tenant-window revocation, graceful host drain,
+        clean producer shutdown — and ``fit`` returns with
+        ``FitResult.preempted`` set."""
         from ddl_tpu.parallel.train import make_train_step
 
         if accum_steps is None:
@@ -135,6 +157,16 @@ class Trainer:
         self.mesh = mesh
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_epochs = max(1, checkpoint_every_epochs)
+        if checkpoint_async is None:
+            from ddl_tpu.utils import env_flag
+
+            checkpoint_async = env_flag("DDL_TPU_CKPT_ASYNC")
+        self.checkpoint_async = bool(checkpoint_async)
+        self.checkpoint_keep = max(1, int(checkpoint_keep))
+        self._ckptr: Any = None  # lazy AsyncCheckpointer
+        self._guard = preemption_guard
+        self._restored_loader_ck: Any = None
+        self._preempted = False
         self.watchdog_enabled = watchdog
         self.watchdog_respawn = watchdog_respawn
         self.stall_budget_s = stall_budget_s
@@ -163,21 +195,78 @@ class Trainer:
         assert self.checkpoint_dir is not None
         return os.path.join(self.checkpoint_dir, "loader.json")
 
+    def _checkpointer(self) -> Any:
+        """The lazily built per-trainer async checkpointer."""
+        if self._ckptr is None:
+            from ddl_tpu.resilience import AsyncCheckpointer
+
+            assert self.checkpoint_dir is not None
+            self._ckptr = AsyncCheckpointer(
+                self.checkpoint_dir, keep=self.checkpoint_keep,
+                metrics=self.metrics,
+            )
+        return self._ckptr
+
     def _restore_or_init(self) -> Tuple[Any, int]:
-        """Returns (train state, epoch to start from)."""
+        """Returns (train state, epoch to start from).
+
+        Restore prefers the VERIFIED source with the newest step:
+        resilience generation files (integrity-trailer checked, loader
+        cursor fenced inside the blob) vs legacy Orbax ``step_*``
+        directories (manifest-verified since ISSUE 14) — so a run that
+        switched checkpointing modes still resumes from its true
+        frontier.  Unverifiable generations of either format are
+        quarantined and the previous verified one restores instead;
+        exhaustion is a cold start (loud counter), never a crash.
+        """
         from ddl_tpu.checkpoint import (
             LoaderCheckpoint,
-            latest_step,
+            latest_verified_step,
             restore_train_state,
+        )
+        from ddl_tpu.resilience import (
+            latest_verified_generation,
+            restore_latest,
         )
 
         state = self._init_fn(self._init_params)
-        if self.checkpoint_dir is None or latest_step(self.checkpoint_dir) is None:
+        self._restored_loader_ck = None
+        if self.checkpoint_dir is None:
             return state, 0
-        state = restore_train_state(self.checkpoint_dir, like=state)
+        gen = latest_verified_generation(
+            self.checkpoint_dir, metrics=self.metrics
+        )
+        legacy_step = latest_verified_step(self.checkpoint_dir)
+        if gen is not None and (
+            legacy_step is None or gen[0] >= legacy_step
+        ):
+            # found=gen: the scan above already CRC'd every candidate —
+            # restore must not re-read the blobs a second time.
+            restored = restore_latest(
+                self.checkpoint_dir, like=state, metrics=self.metrics,
+                found=gen,
+            )
+            assert restored is not None  # gen verified just above
+            self._restored_loader_ck = restored.loader
+            start_epoch = (
+                restored.loader.epoch if restored.loader is not None else 0
+            )
+            logger.info(
+                "trainer: resumed step %d / epoch %d from generation "
+                "checkpoint %s", restored.state.step, start_epoch,
+                self.checkpoint_dir,
+            )
+            return restored.state, start_epoch
+        if legacy_step is None:
+            return state, 0
+        state = restore_train_state(
+            self.checkpoint_dir, like=state, step=legacy_step
+        )
         start_epoch = 0
         if os.path.exists(self._loader_ckpt_path()):
-            start_epoch = LoaderCheckpoint.load(self._loader_ckpt_path()).epoch
+            ck = LoaderCheckpoint.load(self._loader_ckpt_path())
+            self._restored_loader_ck = ck
+            start_epoch = ck.epoch
         logger.info(
             "trainer: resumed step %d / epoch %d from %s",
             state.step, start_epoch, self.checkpoint_dir,
@@ -185,7 +274,8 @@ class Trainer:
         return state, start_epoch
 
     def _checkpoint(
-        self, state: Any, loader: Any, shuffler: Any = None
+        self, state: Any, loader: Any, shuffler: Any = None,
+        force: bool = False, timeout_s: float = 60.0,
     ) -> None:
         # Producer-side shuffler rounds need no explicit capture: on resume
         # ``fit`` replays the consumed windows (``loader.fast_forward``) and
@@ -198,10 +288,66 @@ class Trainer:
         from ddl_tpu.checkpoint import LoaderCheckpoint, save_train_state
 
         assert self.checkpoint_dir is not None
-        save_train_state(state, self.checkpoint_dir)
-        LoaderCheckpoint.capture(loader, shuffler=shuffler).save(
-            self._loader_ckpt_path()
+        ck = LoaderCheckpoint.capture(loader, shuffler=shuffler)
+        if self.checkpoint_async:
+            # Async tier: the measured stall is the D2H snapshot; the
+            # serialize/fsync/rename hides under training.  ``force``
+            # (the preemption drain's final checkpoint) waits for the
+            # bytes to be durably on disk before returning.
+            cp = self._checkpointer()
+            if force:
+                cp.checkpoint_now(state, ck, timeout_s=timeout_s)
+            else:
+                cp.submit(state, ck)
+            return
+        with self.metrics.timed("resilience.ckpt_sync"):
+            save_train_state(state, self.checkpoint_dir)
+            ck.save(self._loader_ckpt_path())
+
+    def _finish_checkpoints(self) -> None:
+        """Bounded flush of the async writer at the end of a fit: the
+        final periodic checkpoint must be durable before the process
+        can exit (the writer is a daemon thread — without this flush a
+        completed run could silently lose its newest generation and a
+        restart would resume one interval early)."""
+        if self._ckptr is None:
+            return
+        from ddl_tpu.exceptions import CheckpointError
+
+        try:
+            self._ckptr.flush(timeout_s=60.0)
+        except CheckpointError:
+            logger.exception(
+                "trainer: async checkpoint flush at fit end failed — "
+                "the newest generation may be missing on restart"
+            )
+
+    def _preempt_drain(
+        self, state: Any, loader: Any, shuffler: Any = None
+    ) -> None:
+        """Run the guard's graceful-drain ladder at a window boundary:
+        forced final checkpoint (state + fenced loader cursor), tenant
+        revocation / host drain (the guard's attached rungs), then a
+        clean producer shutdown — the watchdog sees an orderly close,
+        not failures."""
+        self._preempted = True
+
+        def final_ckpt():
+            if self.checkpoint_dir is not None:
+                # Bounded by the REMAINING grace budget: a wedged
+                # writer must not eat the whole notice window and
+                # starve the revoke/drain/shutdown rungs behind it.
+                self._checkpoint(
+                    state, loader, shuffler=shuffler, force=True,
+                    timeout_s=max(1.0, self._guard.remaining()),
+                )
+
+        self._guard.drain(
+            final_checkpoint=final_ckpt, shutdown=loader.shutdown
         )
+
+    def _should_drain(self) -> bool:
+        return self._guard is not None and self._guard.poll()
 
     # -- evaluation --------------------------------------------------------
 
@@ -397,9 +543,14 @@ class Trainer:
         return FitResult(
             state=state,
             losses=epoch_losses,
-            epochs_run=n_epochs - start_epoch,
+            epochs_run=(
+                len(epoch_losses)
+                if self._preempted
+                else n_epochs - start_epoch
+            ),
             resumed_from_epoch=start_epoch,
             metrics=self.metrics,
+            preempted=self._preempted,
         )
 
     def _fused_stream_loop(
@@ -471,6 +622,13 @@ class Trainer:
                 and epoch % self.checkpoint_every_epochs == 0
             ):
                 self._checkpoint(state, loader, shuffler=hook_state)
+            if self._should_drain():
+                # Graceful preemption drain at the window boundary: the
+                # forced checkpoint inside syncs on the dispatched
+                # scans (device_get at the step-future boundary), so
+                # ZERO completed windows are lost.
+                self._preempt_drain(state, loader, shuffler=hook_state)
+                break
         if pending is not None:
             # Stream drained; the final scan must be consumed.
             epoch_losses.append(float(pending))
@@ -519,6 +677,9 @@ class Trainer:
                 and epoch % self.checkpoint_every_epochs == 0
             ):
                 self._checkpoint(state, loader, shuffler=hook_state)
+            if self._should_drain():
+                self._preempt_drain(state, loader, shuffler=hook_state)
+                break
         return state
 
     # -- the run -----------------------------------------------------------
@@ -656,6 +817,7 @@ class Trainer:
             shuffler_factory=shuffler_factory,
         )
         def _main(env):
+            trainer._preempted = False
             state, start_epoch = trainer._restore_or_init()
             lkw = dict(loader_kwargs or {})
             if output == "jax" and "sharding" not in lkw:
@@ -698,7 +860,13 @@ class Trainer:
             if start_epoch:
                 from ddl_tpu.checkpoint import LoaderCheckpoint
 
-                ck = LoaderCheckpoint.load(trainer._loader_ckpt_path())
+                # The cursor FENCED to the restored train state (it
+                # rode inside the verified generation blob) wins over
+                # the loader.json mirror — a crash between the two
+                # writes can never desync data from params.
+                ck = trainer._restored_loader_ck
+                if ck is None:
+                    ck = LoaderCheckpoint.load(trainer._loader_ckpt_path())
                 # Discard the windows the pre-checkpoint run consumed (one
                 # per epoch): producers regenerate their sequence
                 # deterministically, so resumed epochs see the DATA they
@@ -731,6 +899,7 @@ class Trainer:
                         stream_lookahead=stream_lookahead, fused=fused,
                     )
                 finally:
+                    trainer._finish_checkpoints()
                     if wd is not None:
                         wd.stop()
             try:
@@ -765,15 +934,26 @@ class Trainer:
                         and (epoch + 1) % trainer.checkpoint_every_epochs == 0
                     ):
                         trainer._checkpoint(state, loader)
+                    if trainer._should_drain():
+                        # Batch-path drain at the epoch boundary (the
+                        # stream path drains per window == per epoch).
+                        trainer._preempt_drain(state, loader)
+                        break
             finally:
+                trainer._finish_checkpoints()
                 if wd is not None:
                     wd.stop()
             return FitResult(
                 state=state,
                 losses=epoch_losses,
-                epochs_run=n_epochs - start_epoch,
+                epochs_run=(
+                    len(epoch_losses)
+                    if trainer._preempted
+                    else n_epochs - start_epoch
+                ),
                 resumed_from_epoch=start_epoch,
                 metrics=trainer.metrics,
+                preempted=trainer._preempted,
             )
 
         return _main()

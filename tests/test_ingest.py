@@ -224,6 +224,14 @@ class TestNorthStarReport:
             "wire_encoded_bytes", "wire_payload_bytes",
             "wire_decoded_windows", "wire_decode_fails",
             "wire_fallbacks",
+            # preemption tolerance extras (ISSUE 14: ddl_tpu.resilience
+            # — notice/drain events, async-checkpoint stall split,
+            # restore-ladder health, serve-plane revocations)
+            "resilience_notices", "resilience_drains",
+            "resilience_drain_s", "resilience_ckpts",
+            "resilience_final_ckpts", "resilience_ckpt_submit_s",
+            "resilience_ckpt_write_s", "resilience_ckpt_quarantined",
+            "resilience_ckpt_cold_starts", "serve_revocations",
         }
         assert r["samples_per_sec"] > 0
         # The per-tenant stall block is a DICT keyed by tenant name

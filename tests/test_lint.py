@@ -226,6 +226,19 @@ VIOLATIONS = {
                 data = self.inner.open(path).read()
                 return self.codec.decode_bytes(data)   # unbounded decode
     """,
+    "DDL022": """
+        import json
+
+        import numpy as np
+
+        class LoaderCheckpoint:
+            def save(self, path):
+                with open(path, "w") as f:   # torn on any mid-write crash
+                    json.dump(self.__dict__, f)
+
+        def save_train_state(state, path):
+            np.save(path, state.params)      # straight to the final path
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -522,6 +535,28 @@ CLEAN = {
         def helper_outside_wire_path(rows):
             raw = decode_window(rows, None, rows.shape, "f4", "int8")
             return pack_rows(raw, "int8")   # not a configured function
+    """,
+    "DDL022": """
+        import json
+
+        class LoaderCheckpoint:
+            def save(self, path):
+                atomic_file_write(          # the sanctioned primitive
+                    path, json.dumps(self.__dict__).encode()
+                )
+
+            @staticmethod
+            def load(path):
+                with open(path) as f:       # reads stay clean
+                    return json.load(f)
+
+        def save_train_state(state, path):
+            blob = _serialize(state)
+            atomic_file_write(path, blob)
+
+        def helper_outside_config(path, data):
+            with open(path, "w") as f:      # not a configured function
+                f.write(data)
     """,
 }
 
@@ -852,6 +887,44 @@ class TestSelfTest:
         """
         findings = lint_snippet(tmp_path, "DDL021", src)
         assert [f.code for f in findings] == ["DDL021"]
+
+    def test_ddl022_respects_configured_writer_list(self, tmp_path):
+        """The bare-write ban is scoped to checkpoint_write_functions;
+        pathlib in-place writers and write-mode kwargs fire too."""
+        src = """
+            class CustomCkpt:
+                def persist(self, path, blob):
+                    path.write_bytes(blob)
+
+                def persist_kw(self, path, blob):
+                    with open(path, mode="wb") as f:
+                        f.write(blob)
+        """
+        cfg = LintConfig(checkpoint_write_functions=["OtherCkpt.persist"])
+        findings = lint_snippet(tmp_path, "DDL022", src, config=cfg)
+        assert findings == [], findings
+        cfg = LintConfig(checkpoint_write_functions=[
+            "CustomCkpt.persist", "CustomCkpt.persist_kw",
+        ])
+        findings = lint_snippet(tmp_path, "DDL022", src, config=cfg)
+        assert sorted(f.code for f in findings) == ["DDL022", "DDL022"]
+
+    def test_ddl022_read_and_nonliteral_mode_pass(self, tmp_path):
+        """Reads, non-literal modes (never guessed), and writes inside
+        a NESTED def (checked when IT is configured) stay clean."""
+        src = """
+            def save_train_state(state, path, mode):
+                with open(path) as f:            # read
+                    _ = f.read()
+                with open(path, mode) as f:      # non-literal mode
+                    _ = f
+                def _inner(p, data):
+                    with open(p, "w") as f:      # nested def: not this fn
+                        f.write(data)
+                return _inner
+        """
+        findings = lint_snippet(tmp_path, "DDL022", src)
+        assert findings == [], findings
 
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"

@@ -2,6 +2,7 @@
 
 from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     caches,
+    ckpt_path,
     cluster_loops,
     concurrency,
     device_path,

@@ -1,0 +1,120 @@
+"""Checkpoint-write durability: every write goes temp+rename (DDL022).
+
+A checkpoint file written in place (``open(path, "w")`` straight to the
+final name, ``np.save`` to the final path, ``Path.write_bytes``) is
+torn by any crash between the first byte and the close — and the torn
+file is the NEWEST generation, exactly the one ``latest_verified_step``
+would otherwise resume from.  Repo rule (docs/LINT.md DDL022): every
+file write inside a configured ``checkpoint_write_functions`` function
+must route through the atomic temp+rename helper
+(:func:`ddl_tpu.checkpoint.atomic_file_write` — fsync'd, renamed into
+place, readers see old-or-new never a mix).  Reads stay clean; the
+helper itself carries the one sanctioned bare write under a pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: numpy writers that materialize straight to their path argument.
+_NP_WRITERS = {"save", "savez", "savez_compressed"}
+#: pathlib in-place writers.
+_PATH_WRITERS = {"write_text", "write_bytes"}
+
+
+def _write_mode(call: ast.Call) -> bool:
+    """True when an ``open(...)`` call opens for writing (mode literal
+    containing w/a/x/+).  A missing or non-literal mode reads as the
+    default ``"r"`` — clean (the checker never guesses)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(c in mode.value for c in "wax+")
+    return False
+
+
+@register
+class CheckpointWritePath(Checker):
+    """DDL022: bare file writes inside configured checkpoint writers.
+
+    Functions named in ``[tool.ddl_lint] checkpoint_write_functions``
+    (bare names or ``Class.method``) persist checkpoint state.  Inside
+    one, ``open(..., "w"/"a"/"x")``, ``np.save``/``np.savez*`` and
+    ``Path.write_text``/``write_bytes`` are findings: a crash mid-write
+    leaves a half-written NEWEST generation on the final path.  Route
+    the bytes through ``atomic_file_write`` (temp in the target dir +
+    fsync + ``os.replace``) instead.  Reads (``open(path)``) pass.
+
+    Escape hatch: ``# ddl-lint: disable=DDL022`` with a rationale (the
+    atomic helper's own temp-file write is the one shipped use).
+    """
+
+    code = "DDL022"
+    summary = "bare checkpoint write bypasses the atomic temp+rename helper"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_ckpt_fn(node):
+            self._check_writes(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_ckpt_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "checkpoint_write_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_writes(self, fn: ast.AST) -> None:
+        stack = [fn]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                # A nested def is checked when IT is configured.
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                stack.append(child)
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            if (
+                isinstance(node.func, ast.Name)
+                and seg == "open"
+                and _write_mode(node)
+            ):
+                self._finding(node, fn, "open() for writing")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and seg in _NP_WRITERS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("np", "numpy")
+            ):
+                self._finding(node, fn, f"np.{seg}() to the final path")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and seg in _PATH_WRITERS
+            ):
+                self._finding(node, fn, f".{seg}() in place")
+
+    def _finding(self, node: ast.AST, fn: ast.AST, what: str) -> None:
+        self.report(
+            node,
+            f"{what} inside checkpoint writer "
+            f"{fn.name}()"  # type: ignore[attr-defined]
+            "; a crash mid-write tears the NEWEST generation on its "
+            "final path — route the bytes through the atomic "
+            "temp+rename helper (ddl_tpu.checkpoint.atomic_file_write)",
+        )

@@ -46,6 +46,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL019",  # blocking wait inside a per-tenant serve loop
     "DDL020",  # host sync inside a fused compute/ingest step function
     "DDL021",  # wire-path decode-then-requantize / unbounded codec call
+    "DDL022",  # bare checkpoint write bypassing atomic temp+rename
 )
 
 
@@ -140,6 +141,7 @@ class LintConfig:
         default_factory=lambda: [
             "FairShareScheduler.admit",
             "FairShareScheduler._advance_round_if_stuck",
+            "FairShareScheduler.revoke_inflight",
             "Autoscaler.step",
             "Autoscaler._run",
             "AdmissionController.report",
@@ -173,6 +175,19 @@ class LintConfig:
             "CodecBackend.open",
             "pack_rows",
             "unpack_rows",
+        ]
+    )
+    #: Checkpoint writer functions (bare name or ``Class.method``):
+    #: every file write inside them must route through the atomic
+    #: temp+rename helper (``ddl_tpu.checkpoint.atomic_file_write``) —
+    #: a bare ``open(..., "w")``/``np.save`` to the final path is
+    #: DDL022 (a crash mid-write tears the NEWEST generation).
+    checkpoint_write_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "LoaderCheckpoint.save",
+            "save_train_state",
+            "_write_manifest",
+            "AsyncCheckpointer._write_generation",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -349,6 +364,12 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.fused_step_functions = str_list(
         "fused_step_functions", cfg.fused_step_functions
+    )
+    cfg.wire_path_functions = str_list(
+        "wire_path_functions", cfg.wire_path_functions
+    )
+    cfg.checkpoint_write_functions = str_list(
+        "checkpoint_write_functions", cfg.checkpoint_write_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
