@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench
+.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -93,7 +93,7 @@ check: lint bench-smoke
 # DMA-failure → xla-fallback rung (tests/test_ici.py) + the preemption
 # notice/checkpoint-corruption rows (tests/test_resilience.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py tests/test_resilience.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py tests/test_resilience.py tests/test_obs.py -q
 
 # Distributed-optimizer suite alone (parity matrix, collective units,
 # the 4B fits-only-with-zero1 accounting test).
@@ -129,3 +129,15 @@ preempt-test:
 # bound — byte-identical resume asserted in the artifact.
 preempt-bench:
 	DDL_BENCH_MODE=preempt JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Tracing-layer suite alone (Metrics histograms, SpanLog/Chrome export,
+# cross-process aggregation, flight recorder, the doc-reflection test;
+# docs/OBSERVABILITY.md).
+obs-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q
+
+# The tracing layer priced end to end: armed-vs-disarmed span/recorder
+# overhead A/B (ceiling <= 2%, byte-identical), histogram percentiles
+# in the armed report, and the seeded-corruption flight-record leg.
+obs-bench:
+	DDL_BENCH_MODE=obs JAX_PLATFORMS=cpu $(PY) bench.py

@@ -39,9 +39,11 @@ from ddl_tpu.exceptions import (
     ShutdownRequested,
     StallTimeoutError,
 )
+from ddl_tpu.obs import spans as obs_spans
+from ddl_tpu.obs.recorder import flight_dump
 from ddl_tpu.observability import Metrics, metrics as default_metrics
-from ddl_tpu.transport.connection import ConsumerConnection
-from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer
+from ddl_tpu.transport.connection import NOTHING, ConsumerConnection
+from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer, ObsReport
 from ddl_tpu.utils import for_all_methods, with_logging
 
 logger = logging.getLogger("ddl_tpu")
@@ -158,6 +160,18 @@ class DistributedDataLoader:
         # window acquisition passes the fair-share gate before touching
         # a ring, and charges its byte size after — see bind_admission.
         self._admission: Any = None
+        # Cross-process observability (ddl_tpu.obs): PROCESS workers
+        # ship ObsReports over the control channel; the merger fences
+        # and folds them into this registry under producer.<idx>.*.
+        # Built lazily on the first cross-process report poll.
+        self._obs_merger: Any = None
+        # Logical seq of the most recent successful head acquire — the
+        # window-identity key the span/staging instrumentation stitches
+        # on (consumer thread only, like the rotation state).
+        self._last_acquired_seq: Optional[int] = None
+        # Identity key of the most recently YIELDED stream window (the
+        # trainer's consume spans read it — see last_window_key).
+        self._last_window_key: Any = None
         if output == "jax":
             from ddl_tpu.ingest import DeviceIngestor
 
@@ -430,8 +444,8 @@ class DistributedDataLoader:
         # ring slots; count them so this stream's drain-lookahead
         # accounting (acquire_drain_ahead(held)) skips past them, and
         # sweep them out as their transfers complete.
-        for _t, _slot, _dev in self._release_backlog:
-            held[_t] += 1
+        for _entry in self._release_backlog:
+            held[_entry[0]] += 1
         # FIFO of [slot, target, payload, samples, slot_released] with
         # transfers in flight; at most 1 + lookahead entries.  payload is
         # a device array (inline) or a StagedTransfer handle (staged).
@@ -492,6 +506,11 @@ class DistributedDataLoader:
                         cursor = self._next_target(cursor, include=True)
                         target = cursor
             ring = self.connection.rings[target]
+            # Window identity (the integrity trailer's (producer_idx,
+            # seq)) — the key every downstream span of THIS window
+            # stitches on (staging copy/transfer, H2D, ICI fan-out,
+            # trainer consume, slot release).
+            wkey = (target + 1, self._last_acquired_seq)
             arr = self._slot_array(target, slot)
             # Ragged tail rows (nData not a batch multiple) are unserved,
             # exactly as in batch iteration.  bpw is per-TARGET: mixed
@@ -539,14 +558,22 @@ class DistributedDataLoader:
                     lambda buf: (ingestor._transfer(buf),) * 2,
                     expected_crc=expected_crc,
                     alias_src=alias,
+                    span_key=wkey,
                 )
             else:
-                payload = self._ingestor.put_window(
-                    window, defer_metrics=True
-                )
+                # Identity context for the nested transfer/fan-out
+                # spans (put_window, IciDistributor) — they run on this
+                # thread and cannot see the window key otherwise.
+                obs_spans.set_window(*wkey)
+                try:
+                    payload = self._ingestor.put_window(
+                        window, defer_metrics=True
+                    )
+                finally:
+                    obs_spans.clear_window()
             held[target] += 1
             cursor = self._next_target(cursor)
-            return [slot, target, payload, served, False]
+            return [slot, target, payload, served, False, wkey]
 
         def release_early():
             """Staged mode: hand back the slots of every pending window
@@ -562,7 +589,7 @@ class DistributedDataLoader:
             stream inherits and serves it — the break-resume contract
             survives early release."""
             for entry in pending:
-                slot, target, payload, _served, released = entry
+                slot, target, payload, _served, released = entry[:5]
                 if released:
                     continue
                 if not isinstance(payload, StagedTransfer):
@@ -574,12 +601,13 @@ class DistributedDataLoader:
                 if not payload.copy_done.is_set():
                     break
                 self.connection.rings[target].release(slot)
+                obs_spans.mark("consumer.release", *entry[5])
                 held[target] -= 1
                 entry[4] = True
                 self._staged_orphans.append(entry)
 
         def finish(entry):
-            slot, target, payload, served, released = entry
+            slot, target, payload, served, released, wkey = entry
             if isinstance(payload, StagedTransfer):
                 # Wait only for the staging copy + dispatch (the slot's
                 # last reader), not the whole transfer — the device value
@@ -622,7 +650,7 @@ class DistributedDataLoader:
                     # (Named distinctly from the enclosing ``entry``
                     # parameter — the pending-queue 5-tuple — which the
                     # staged-orphan branch below still reads.)
-                    backlog_entry = [target, slot, dev]
+                    backlog_entry = [target, slot, dev, wkey]
                     self._release_backlog.append(backlog_entry)
                     self._last_stream_entry = backlog_entry
                 else:
@@ -631,12 +659,15 @@ class DistributedDataLoader:
                     # alias-guard copy in ``put_window``): nothing reads
                     # the slot anymore, hand it back now.
                     self.connection.rings[target].release(slot)
+                    obs_spans.mark("consumer.release", *wkey)
                     held[target] -= 1
             elif self._staged_orphans and self._staged_orphans[0] is entry:
                 # Yielded after its early release: no longer an orphan.
                 self._staged_orphans.pop(0)
             # This window is now SERVED: commit the rotation.
             self._target = self._next_target(target)
+            self._last_window_key = wkey
+            obs_spans.mark("consumer.yield", *wkey)
             return dev
 
         # Inherit a superseded/abandoned stream's early-released windows:
@@ -657,6 +688,9 @@ class DistributedDataLoader:
             check_live()
             if self._finalized:
                 break
+            # Cross-process observability: fold any pending worker
+            # ObsReports in at the window boundary (no-op in THREAD).
+            self._poll_obs()
             if self._release_backlog:
                 # Free completed-transfer slots (non-blocking probe)
                 # before acquiring or deepening.
@@ -721,6 +755,88 @@ class DistributedDataLoader:
                     lookahead = 0
                     break
             yield finish(pending.popleft())
+
+    # -- cross-process observability drain (ddl_tpu.obs) -------------------
+
+    def _poll_obs(self) -> None:
+        """Drain pending producer ObsReports (non-blocking, once per
+        window boundary) and merge them into this registry under
+        ``producer.<idx>.*``.  THREAD-mode channels never carry reports
+        (the worker registry IS this one), so the poll is a cheap
+        per-window no-op there."""
+        self._drain_obs_once()
+
+    def _obs_reports_possible(self) -> bool:
+        """Could this loader's producers ship ObsReports at all?
+        Cross-process channels with shipping enabled — THREAD loaders
+        (in-process queues, shared registry) never wait on teardown."""
+        from ddl_tpu.obs import ship_every
+        from ddl_tpu.transport.connection import ThreadChannel
+
+        return ship_every() > 0 and any(
+            not isinstance(ch, ThreadChannel)
+            for ch in self.connection.channels
+        )
+
+    def drain_obs_reports(
+        self, timeout_s: float = 0.0, wait_for_all: bool = False
+    ) -> int:
+        """Drain producer ObsReports, optionally waiting up to
+        ``timeout_s`` for stragglers (a PROCESS worker's FINAL report
+        races teardown) — the shutdown/bench/test hook; the per-window
+        poll is :meth:`_poll_obs`.  ``wait_for_all`` exits EARLY once a
+        FRESH report (one applied after this call started) has arrived
+        from every producer — a clean teardown pays only the real
+        straggler latency, never the whole deadline; crashed producers
+        never report, so the deadline stays the upper bound.  Returns
+        reports applied."""
+        import threading
+
+        deadline = time.monotonic() + timeout_s
+        waiter = threading.Event()
+        applied = 0
+        start_state = (
+            self._obs_merger.fence_state()
+            if self._obs_merger is not None
+            else {}
+        )
+        targets = set(range(self.n_producers))
+        while True:
+            applied += self._drain_obs_once()
+            if wait_for_all and self._obs_merger is not None:
+                state = self._obs_merger.fence_state()
+                if all(
+                    t in state and state[t] != start_state.get(t)
+                    for t in targets
+                ):
+                    return applied
+            if timeout_s <= 0 or time.monotonic() >= deadline:
+                return applied
+            waiter.wait(0.02)
+
+    def _drain_obs_once(self) -> int:
+        applied = 0
+        for target in range(self.n_producers):
+            while True:
+                msg = self.connection.try_recv_control(target)
+                if msg is NOTHING:
+                    break
+                if isinstance(msg, ObsReport):
+                    if self._obs_merger is None:
+                        from ddl_tpu.obs import ReportMerger
+
+                        self._obs_merger = ReportMerger(
+                            self.metrics, obs_spans.log
+                        )
+                    if self._obs_merger.apply(msg):
+                        applied += 1
+                else:
+                    logger.warning(
+                        "consumer: ignoring unexpected producer "
+                        "message %r on channel %d",
+                        type(msg).__name__, target,
+                    )
+        return applied
 
     # -- loader-pool decoupling seam (ddl_tpu.cluster) ---------------------
 
@@ -810,6 +926,12 @@ class DistributedDataLoader:
                 e[2] = (e[2], done)
                 self.metrics.incr("ingest.fused_gated")
                 return
+
+    def last_window_key(self) -> Any:
+        """Identity ``(producer_idx, seq)`` of the most recently yielded
+        stream window — the trainer's consume spans key on it
+        (``ddl_tpu.obs``).  None before the first yield."""
+        return self._last_window_key
 
     def bind_admission(self, admission: Any) -> None:
         """Attach a multi-tenant admission gate (``ddl_tpu.serve``).
@@ -934,6 +1056,7 @@ class DistributedDataLoader:
             if hdr.scale_bytes
             else None
         )
+        _span_t0 = obs_spans.t0()
         for attempt in (1, 2):
             try:
                 fault_point("wire.decode", view=view[:nbytes])
@@ -946,10 +1069,17 @@ class DistributedDataLoader:
             except DecodeError as e:
                 self.metrics.incr("wire.decode_fails")
                 if attempt == 2:
+                    flight_dump(
+                        "wire.undecodable",
+                        producer_idx=target + 1, seq=hdr.seq,
+                        metrics=self.metrics,
+                        extra={"wire_dtype": hdr.wire_dtype},
+                    )
                     raise IntegrityError(
                         f"window from producer {target + 1} undecodable "
                         f"after retry ({hdr.wire_dtype} wire): {e}"
                     ) from e
+        obs_spans.record("wire.decode", target + 1, hdr.seq, _span_t0)
         self.metrics.incr("wire.decoded_windows")
         # The wire accounting pair (encoded bytes that traveled the
         # slot vs the logical raw bytes served) — counted HERE, the one
@@ -971,9 +1101,11 @@ class DistributedDataLoader:
         blocked: set = set()
         remaining = []
         for entry in self._release_backlog:
-            target, slot, dev = entry
+            target, slot, dev = entry[:3]
             if target not in blocked and _transfer_ready(dev):
                 self.connection.rings[target].release(slot)
+                if len(entry) > 3:
+                    obs_spans.mark("consumer.release", *entry[3])
                 if held is not None:
                     held[target] -= 1
             else:
@@ -993,13 +1125,15 @@ class DistributedDataLoader:
         remaining = []
         done = False
         for entry in self._release_backlog:
-            t, slot, dev = entry
+            t, slot, dev = entry[:3]
             if done or (target is not None and t != target):
                 remaining.append(entry)
                 continue
             with self.metrics.timed("ingest.release_wait"):
                 jax.block_until_ready(dev)
             self.connection.rings[t].release(slot)
+            if len(entry) > 3:
+                obs_spans.mark("consumer.release", *entry[3])
             if held is not None:
                 held[t] -= 1
             if target is not None:
@@ -1047,23 +1181,60 @@ class DistributedDataLoader:
         SLO on a phantom window.
         """
         if self._admission is None:
-            return self._acquire_slot_verified(target, ahead, timeout_s)
+            return self._acquire_with_spans(target, ahead, timeout_s)
         t_admit = time.monotonic()
+        _span_t0 = obs_spans.t0()
         self._admission.admit(timeout_s)
+        admit_wait = time.monotonic() - t_admit
         if timeout_s > 0:
-            timeout_s = max(0.0, timeout_s - (time.monotonic() - t_admit))
+            timeout_s = max(0.0, timeout_s - admit_wait)
         try:
-            slot = self._acquire_slot_verified(target, ahead, timeout_s)
+            slot = self._acquire_with_spans(target, ahead, timeout_s)
         except BaseException:
             abort = getattr(self._admission, "note_aborted", None)
             if abort is not None:
                 abort()
             raise
+        # Admission observability: the span is keyed on the window the
+        # grant actually bought (seq known only post-acquire), and the
+        # wait lands in the bounded consumer.admission_wait histogram —
+        # the first-class home of the p99 the tenancy bench previously
+        # computed ad hoc (per-tenant histograms ride
+        # ingest.<tenant>.admission_wait in ddl_tpu.serve).
+        obs_spans.record(
+            "consumer.admission", target + 1, self._last_acquired_seq,
+            _span_t0, _span_t0 + admit_wait if _span_t0 else None,
+        )
+        self.metrics.observe("consumer.admission_wait", admit_wait)
         # The charge-after half of the fair-share gate: the window's
         # actual byte size is only known post-acquire.
         self._admission.note_served(
             int(self.connection.rings[target].slot_payload(slot))
         )
+        return slot
+
+    def _acquire_with_spans(
+        self, target: int, ahead: int, timeout_s: float
+    ):
+        """The acquire choke point's observability shim: spans the
+        verified acquire, stashes the logical seq for downstream keying
+        (staging jobs, yields, releases), and feeds the bounded
+        ``consumer.window_latency`` histogram — head acquires only, so
+        the percentile measures "time to obtain the next committed
+        window" and non-blocking lookahead probes cannot dilute it."""
+        _span_t0 = obs_spans.t0()
+        t0 = time.perf_counter() if ahead == 0 and timeout_s > 0 else 0.0
+        slot = self._acquire_slot_verified(target, ahead, timeout_s)
+        # The logical window number, by the same arithmetic the
+        # integrity verify pins (valid with integrity off too: the skew
+        # term is only ever advanced by quarantine replays).
+        seq = self._expected_seq(target, ahead)
+        self._last_acquired_seq = seq
+        if t0:
+            self.metrics.observe(
+                "consumer.window_latency", time.perf_counter() - t0
+            )
+        obs_spans.record("consumer.acquire", target + 1, seq, _span_t0)
         return slot
 
     def _acquire_slot_verified(
@@ -1131,6 +1302,15 @@ class DistributedDataLoader:
                     # loader's real timeout.
                     raise _CorruptAhead(err)
                 self.metrics.incr("integrity.corrupt_windows")
+                # Post-mortem artifact (ddl_tpu.obs): the corrupt
+                # window is THE event a chaos row or chip-run anomaly
+                # needs explained — dump the flight ring naming the
+                # faulted window's trailer identity (no-op disarmed).
+                flight_dump(
+                    "integrity.corrupt_window",
+                    producer_idx=target + 1, seq=expect,
+                    metrics=self.metrics, extra={"verify_error": err},
+                )
                 slot = self._quarantine_and_replay(
                     target, expect, err, timeout_s
                 )
@@ -1221,6 +1401,11 @@ class DistributedDataLoader:
                 # Replayed copy is corrupt AGAIN: burn a replay attempt.
                 self.metrics.incr("integrity.corrupt_windows")
                 reattempt = True
+        flight_dump(
+            "integrity.replay_exhausted",
+            producer_idx=target + 1, seq=seq,
+            metrics=self.metrics, extra={"verify_error": err},
+        )
         raise IntegrityError(
             f"window {seq} from producer {target + 1} still corrupt "
             f"after {self._max_replays} replay(s): {err}"
@@ -1246,6 +1431,7 @@ class DistributedDataLoader:
         # The annotation makes window-wait stalls visible on the profiler
         # timeline next to the XLA ops (SURVEY §5.1 TPU-native tracing).
         self._apply_pending_pool()
+        self._poll_obs()
         with annotate("ddl.window_acquire"), self.metrics.timed(
             "consumer.wait"
         ):
@@ -1329,6 +1515,18 @@ class DistributedDataLoader:
             # and completed staging buffers flush back to their pool.
             self._ingestor.close()
         self.connection.shutdown_operation()
+        # Final observability drain: PROCESS workers ship a last
+        # cumulative ObsReport on their way out — give stragglers a
+        # short bounded window before the channels close, exiting
+        # early once every producer's final report landed (a run
+        # SHORTER than the periodic ship cadence has its whole
+        # aggregation riding on exactly this drain, so the gate is
+        # "could reports exist at all", not "did one arrive already";
+        # a crashed worker never ships and the deadline bounds it).
+        if self._obs_reports_possible():
+            self.drain_obs_reports(timeout_s=0.5, wait_for_all=True)
+        else:
+            self._drain_obs_once()
         self.connection.finalize()
         logger.debug("consumer: shutdown complete after epoch %d", self._epoch)
 

@@ -36,6 +36,8 @@ from ddl_tpu import integrity
 from ddl_tpu.datasetwrapper import DataProducerOnInitReturn
 from ddl_tpu.exceptions import DoesNotMatchError, ShutdownRequested
 from ddl_tpu.faults import armed_plan, fault_point
+from ddl_tpu.obs import aggregate as obs_aggregate
+from ddl_tpu.obs import spans as obs_spans
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import NOTHING, ProducerConnection
 from ddl_tpu.types import (
@@ -112,6 +114,14 @@ class DataPusher:
         self._iteration = 0
         # Last applied cluster view epoch (ShardAdoption fence).
         self._view_epoch = -1
+        # Cross-process observability shipping (ddl_tpu.obs): PROCESS
+        # workers periodically send cumulative Metrics snapshots (+
+        # armed-span deltas) back over this control channel; THREAD
+        # producers share the consumer registry and never ship.
+        self._obs_ship_every = (
+            obs_aggregate.ship_every() if connection.cross_process else 0
+        )
+        self._obs_report_idx = 0
 
         # End-to-end window integrity (ddl_tpu.integrity): slots carry a
         # checksummed trailer header past the payload; the flag rides the
@@ -702,6 +712,11 @@ class DataPusher:
                     producer_idx=self.producer_idx,
                     should_abort=self.ring.is_shutdown,
                 )
+                # Window lifecycle span (ddl_tpu.obs): the fill stage —
+                # exchange + user refill — keyed on the same
+                # (producer_idx, seq) identity the integrity trailer
+                # stamps.  One attribute read when tracing is disarmed.
+                _span_t0 = obs_spans.t0()
                 execute_callbacks(
                     self.callbacks,
                     "global_shuffle",
@@ -718,6 +733,10 @@ class DataPusher:
                     "execute_function",
                     my_ary=self.my_ary,
                     iteration=self._iteration,
+                )
+                obs_spans.record(
+                    "producer.fill", self.producer_idx, self._iteration,
+                    _span_t0,
                 )
                 if self.inplace_fill and armed_plan() is not None:
                     # Chaos hook for the write-once path: fires with the
@@ -738,11 +757,20 @@ class DataPusher:
                         ],
                         should_abort=self.ring.is_shutdown,
                     )
+                _span_t0 = obs_spans.t0()
                 self._commit_window()
+                # The commit span covers acquire_fill's free-slot wait
+                # too — producer-side backpressure is exactly what a
+                # trace of a slow consumer should show.
+                obs_spans.record(
+                    "producer.commit", self.producer_idx, self._iteration,
+                    _span_t0,
+                )
                 execute_callbacks(
                     self.callbacks, "on_shuffle_end", iteration=self._iteration
                 )
                 self._iteration += 1
+                self._maybe_ship_obs()
         except ShutdownRequested:
             clean = True
             logger.debug(
@@ -754,7 +782,40 @@ class DataPusher:
             execute_callbacks(self.callbacks, "on_push_end")
             self._finalize(clean=clean)
 
+    def _maybe_ship_obs(self, final: bool = False) -> None:
+        """Ship one cross-process ObsReport (ddl_tpu.obs aggregation)
+        when due: every ``_obs_ship_every`` windows, plus a ``final``
+        ship at shutdown so short runs still aggregate.  PROCESS mode
+        only (``_obs_ship_every`` is 0 for THREAD producers, whose
+        registry IS the consumer's).  A broken channel (consumer gone
+        first during teardown) drops the report — observability must
+        never escalate a clean shutdown."""
+        every = self._obs_ship_every
+        if every <= 0:
+            return
+        if not final and self._iteration % every:
+            return
+        self._obs_report_idx += 1
+        report = obs_aggregate.build_report(
+            self.producer_idx - 1,  # consumer-side 0-based ring index
+            self._obs_report_idx,
+            self.metrics,
+            view_epoch=self._view_epoch,
+        )
+        try:
+            self.connection.channel.send(report)
+        except (OSError, ValueError) as e:
+            logger.debug(
+                "producer %d: obs report dropped (%s)",
+                self.producer_idx, e,
+            )
+
     def _finalize(self, clean: bool = True) -> None:
+        if clean:
+            # Final observability ship BEFORE the channel closes: the
+            # consumer's shutdown drain is what closes the PROCESS-mode
+            # blind spot for runs shorter than the periodic cadence.
+            self._maybe_ship_obs(final=True)
         # A CRASHING producer must leave the shm ring linked: elastic
         # recovery (WorkerSet.respawn) attaches a replacement to it by
         # name.  Only a clean shutdown removes the name; the consumer's

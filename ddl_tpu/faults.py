@@ -263,6 +263,20 @@ class FaultPlan:
                         (site, spec.kind.value, producer_idx, n)
                     )
                     due.append(spec)
+        if due:
+            # Post-mortem trail (ddl_tpu.obs): a fault-site trip dumps
+            # the flight ring when a recorder is armed (no-op, and no
+            # import, otherwise) — every chaos-matrix row and chip-run
+            # anomaly leaves an artifact.  Lazy import: faults must not
+            # pull the obs layer into processes that never arm it.
+            from ddl_tpu.obs import recorder as _flight
+
+            if _flight.armed_recorder() is not None:
+                for spec in due:
+                    _flight.flight_dump(
+                        f"fault.{site}.{spec.kind.value}",
+                        producer_idx=producer_idx,
+                    )
         for spec in due:
             self._act(spec, view=view, should_abort=should_abort)
 

@@ -340,6 +340,7 @@ class DeviceIngestor:
         measurement span (a dispatch-time count leads completion by the
         whole lookahead depth).
         """
+        from ddl_tpu.obs import spans as obs_spans
         from ddl_tpu.profiling import annotate
 
         if self._target_platform() == "cpu":
@@ -349,8 +350,15 @@ class DeviceIngestor:
             # accelerator the put is a genuine transfer and the zero-copy
             # path is safe.
             window = np.array(window, copy=True)
+        # Dispatch span, keyed on the thread's current-window context
+        # (set by the stream / staging executor) — the transfer itself
+        # is async; completion shows up as the consumer.release mark.
+        _span_t0 = obs_spans.t0()
         with annotate("ddl.ingest_put_window"):
             out = self._transfer(window)
+        obs_spans.record(
+            "ingest.transfer", *obs_spans.current_window(), _span_t0
+        )
         if not defer_metrics:
             self.metrics.incr("ingest.bytes", float(window.nbytes))
             self.metrics.incr("ingest.windows")
@@ -619,6 +627,55 @@ def north_star_report(
         "resilience.ckpt_cold_starts"
     )
     report["serve_revocations"] = m.counter("serve.revocations")
+    # End-to-end tracing layer (ISSUE 15: ddl_tpu.obs).  Percentiles
+    # come from the bounded log-spaced histograms Metrics.observe
+    # feeds: window latency (time a blocking head acquire waited for
+    # its committed window) and the fair-share admission wait — the
+    # p99s the tenancy/preempt benches previously computed ad hoc.
+    report["window_latency_p50"] = m.quantile(
+        "consumer.window_latency", 0.5
+    )
+    report["window_latency_p99"] = m.quantile(
+        "consumer.window_latency", 0.99
+    )
+    report["admission_wait_p99"] = m.quantile("serve.admission_wait", 0.99)
+    # Per-tenant admission p99s.  Tenants are discovered from the
+    # histogram names themselves (every admit observes into
+    # ingest.<tenant>.admission_wait), so the dict is complete even
+    # when no AdmissionController.report() refreshed the stall gauges.
+    _suffix = ".admission_wait"
+    report["serve_tenant_admission_p99"] = {
+        name[len("ingest."):-len(_suffix)]: m.quantile(name, 0.99)
+        for name in m.hist_names("ingest.")
+        if name.endswith(_suffix)
+    }
+    # Where the per-window time went, by pipeline stage: the curated
+    # always-on timers every mode records, plus (when span tracing is
+    # armed) the SpanLog's measured per-stage totals under their lane
+    # names — one dict the bench JSON charts instead of ten scattered
+    # *_s keys.
+    breakdown = {
+        "acquire_wait": m.timer("consumer.wait").total_s,
+        "stage_copy": m.timer("ingest.stage_copy").total_s,
+        "transfer": m.timer("ingest.transfer").total_s,
+        "release_wait": m.timer("ingest.release_wait").total_s,
+        "window_wait": m.timer("trainer.window_wait").total_s,
+        "admission_wait": m.timer("serve.admission_wait").total_s,
+        "ici_fanout": m.timer("ici.fanout").total_s,
+    }
+    from ddl_tpu.obs import spans as _obs_spans
+
+    _slog = _obs_spans.log()
+    if _slog is not None:
+        for stage, total in _slog.stage_totals().items():
+            breakdown[f"span.{stage}"] = total
+    report["stage_breakdown"] = breakdown
+    # Cross-process aggregation health: reports merged vs dropped
+    # stale, and the flight recorder's dump count — zero in THREAD
+    # mode / disarmed runs by construction.
+    report["obs_reports_applied"] = m.counter("obs.reports_applied")
+    report["obs_reports_stale"] = m.counter("obs.reports_stale")
+    report["obs_flight_dumps"] = m.counter("obs.flight_dumps")
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (

@@ -1,0 +1,129 @@
+"""Cross-process metric/span aggregation: PROCESS-mode worker
+registries merged into the consumer's under ``producer.<idx>.*``.
+
+The Metrics docstring carried the caveat from day one: producer-side
+counters live "per worker process in PROCESS mode" — the consumer's
+registry (and therefore ``north_star_report``, the bench JSON, and
+every BENCH_* trajectory) was blind to ``shuffle.*`` ladder events,
+``wire.*`` exchange fallbacks, and producer fill timings whenever the
+producers ran as spawned processes.  This module closes that blind
+spot over the transport that already exists: workers periodically ship
+an :class:`~ddl_tpu.types.ObsReport` (a cumulative
+``Metrics.snapshot()`` + histogram states + armed-span deltas) over
+the same control channel ``ReplayRequest``/``ShardAdoption`` ride, and
+the consumer merges each report into its registry via
+``Metrics.adopt`` — REPLACE-based (snapshots are cumulative) and
+fenced (``report_idx`` monotone per producer; stale reports are
+dropped, the ShardAdoption epoch-fence pattern).
+
+Cost model: one snapshot + one pickle per :data:`ship_every` windows
+per producer (default 32, ``DDL_TPU_OBS_SHIP_EVERY``; ``0`` disables)
+plus a final ship at producer shutdown so short runs still aggregate.
+THREAD-mode producers share the consumer registry already and never
+ship.  The consumer drains reports non-blockingly at window
+boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ddl_tpu")
+
+SHIP_ENV = "DDL_TPU_OBS_SHIP_EVERY"
+DEFAULT_SHIP_EVERY = 32
+
+
+def ship_every() -> int:
+    """Windows between periodic worker ObsReports (0 = disabled)."""
+    raw = os.environ.get(SHIP_ENV, "")
+    if not raw:
+        return DEFAULT_SHIP_EVERY
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_SHIP_EVERY
+
+
+def build_report(
+    producer_idx: int,
+    report_idx: int,
+    metrics: Any,
+    view_epoch: int = 0,
+) -> Any:
+    """Assemble one worker-side ObsReport: cumulative snapshot +
+    histogram states + (when spans are armed) the span delta since the
+    last report."""
+    from ddl_tpu.obs import spans
+    from ddl_tpu.types import ObsReport
+
+    span_log = spans.log()
+    return ObsReport(
+        producer_idx=producer_idx,
+        report_idx=report_idx,
+        pid=os.getpid(),
+        snapshot=metrics.snapshot(),
+        hists=metrics.hist_state(),
+        spans=span_log.drain_new() if span_log is not None else [],
+        view_epoch=view_epoch,
+    )
+
+
+class ReportMerger:
+    """Consumer-side half: fence + merge ObsReports into a registry.
+
+    One instance per loader; NOT thread-safe by design — reports are
+    applied on the consumer thread at window boundaries, exactly like
+    pool updates.
+    """
+
+    def __init__(self, metrics: Any, span_log_getter: Any = None):
+        self.metrics = metrics
+        # Injected so the merger always appends into the CURRENTLY
+        # armed log (arming can change between reports).
+        self._span_log_getter = span_log_getter
+        # producer_idx -> (pid, last applied report_idx).  The fence is
+        # PER INCARNATION: a respawned producer (fresh process, fresh
+        # counter) must not be fenced out by its predecessor's higher
+        # report_idx — the pid change resets the fence.  Bounded by
+        # the producer set by construction.
+        self._applied: Dict[int, tuple] = {}  # ddl-lint: disable=DDL013
+        self.applied_reports = 0
+        self.stale_dropped = 0
+
+    def fence_state(self) -> Dict[int, tuple]:
+        """Copy of the per-producer (pid, report_idx) fence — drain
+        loops compare states to detect 'a fresh report from every
+        producer arrived' and exit before their deadline."""
+        return dict(self._applied)
+
+    def apply(self, report: Any) -> bool:
+        """Merge one report; False when dropped as stale."""
+        pid, last = self._applied.get(report.producer_idx, (None, -1))
+        if pid == report.pid and report.report_idx <= last:
+            self.stale_dropped += 1
+            self.metrics.incr("obs.reports_stale")
+            return False
+        self._applied[report.producer_idx] = (
+            report.pid, report.report_idx,
+        )
+        self.metrics.adopt(
+            f"producer.{report.producer_idx}.",
+            report.snapshot,
+            report.hists,
+        )
+        if report.spans:
+            from ddl_tpu.obs import spans
+
+            span_log = (
+                self._span_log_getter()
+                if self._span_log_getter is not None
+                else spans.log()
+            )
+            if span_log is not None:
+                span_log.record_many(report.spans)
+        self.applied_reports += 1
+        self.metrics.incr("obs.reports_applied")
+        return True

@@ -677,7 +677,17 @@ class IciDistributor:
             except PlanError:
                 pass  # counted+logged once in plan(); per-geometry xla
             else:
-                return self.distribute(device_put(arr, anchor))
+                # Fan-out DISPATCH span, keyed on the thread's current
+                # window (ddl_tpu.obs; the ring kernels are async — the
+                # span is the host-side cost the fused step must hide).
+                from ddl_tpu.obs import spans as obs_spans
+
+                _span_t0 = obs_spans.t0()
+                out = self.distribute(device_put(arr, anchor))
+                obs_spans.record(
+                    "ici.fanout", *obs_spans.current_window(), _span_t0
+                )
+                return out
         return device_put(arr, self.sharding)
 
     def distribute(self, block: Any) -> Any:

@@ -121,6 +121,9 @@ class PreemptionGuard:
         self._notice_t: Optional[float] = None
         self._reason = ""
         self._drained = False
+        # Flight-record dump pending flag: the dump itself is DEFERRED
+        # to poll()/drain() on the main thread (see _flight_dump_once).
+        self._flight_dumped = False
         self._prev_handler: Any = None
         self._installed = False
 
@@ -178,17 +181,41 @@ class PreemptionGuard:
             self._reason, self.deadline_s,
         )
 
+    def _flight_dump_once(self) -> None:
+        """Post-mortem artifact (ddl_tpu.obs): capture the pipeline's
+        state AT the notice, so a drain that later overruns its grace
+        budget has a before picture (no-op when no recorder is armed).
+        Deferred OUT of :meth:`notify` on purpose: notify runs inside
+        the SIGTERM handler, and a dump there (metrics snapshot under
+        the registry lock, recorder lock, file IO) could re-enter a
+        lock the interrupted main thread already holds and deadlock
+        the drain — the exact hazard class PR 14 fixed for the guard's
+        own lock.  This runs on the main thread only (poll / drain, at
+        window boundaries)."""
+        if self._flight_dumped or self._notice_t is None:
+            return
+        self._flight_dumped = True
+        from ddl_tpu.obs.recorder import flight_dump
+
+        flight_dump(
+            "resilience.preemption_notice",
+            metrics=self.metrics,
+            extra={"reason": self._reason, "grace_s": self.deadline_s},
+        )
+
     def poll(self) -> bool:
         """The trainer's once-per-window-boundary check: True once a
         notice is pending (signal, env knob, chaos site, or a prior
         :meth:`notify`)."""
         if self._notice_t is not None:
+            self._flight_dump_once()
             return True
         try:
             # Chaos site: PREEMPT_NOTICE raises the real type below.
             fault_point("resilience.notice")
         except PreemptionNotice as n:
             self.notify("injected", deadline_s=n.deadline_s or None)
+            self._flight_dump_once()
             return True
         env = os.environ.get(NOTICE_ENV, "")
         if env and env.lower() not in ("0", "off", "false"):
@@ -197,6 +224,7 @@ class PreemptionGuard:
             except ValueError:
                 deadline = None
             self.notify(f"{NOTICE_ENV}={env}", deadline_s=deadline)
+            self._flight_dump_once()
             return True
         return False
 
@@ -233,6 +261,10 @@ class PreemptionGuard:
         applicable rung completed inside the budget.
         """
         t0 = self._clock()
+        # Catch-all for drains entered without a poll (programmatic
+        # notify + direct drain): still safe — drain runs on the main
+        # thread, never in the signal handler.
+        self._flight_dump_once()
         self.metrics.incr("resilience.drains")
         within = True
         if final_checkpoint is not None:

@@ -175,6 +175,12 @@ class FairShareScheduler:
                 # A departing tenant may have been the only non-grantable
                 # waiter blocking a round advance — wake the others.
                 self._cond.notify_all()
+        # Retire the departed tenant's stall gauge WITH its ``.max``
+        # high-water companion: zeroing (or just dropping the base)
+        # leaves a stale ``serve.stall.<name>.max`` observable between
+        # bench reps, and north_star_report's per-tenant dict would
+        # keep reporting a tenant that no longer exists.
+        self.metrics.clear_gauge(f"serve.stall.{name}")
 
     def tenants(self) -> "list[str]":
         with self._cond:
@@ -238,6 +244,14 @@ class FairShareScheduler:
         self.metrics.incr("serve.admissions")
         self.metrics.add_time("serve.admission_wait", wait)
         self.metrics.add_time(f"ingest.{name}.admission_wait", wait)
+        # First-class percentiles (ddl_tpu.obs): the global and
+        # per-tenant admission-wait distributions land in bounded
+        # log-spaced histograms — north_star_report's
+        # admission_wait_p99 / per-tenant p99s read them back, and the
+        # tenancy bench's independently computed percentile must agree
+        # (tests/test_obs.py pins the agreement).
+        self.metrics.observe("serve.admission_wait", wait)
+        self.metrics.observe(f"ingest.{name}.admission_wait", wait)
 
     def note_aborted(self, name: str) -> None:
         """Release a grant whose ring acquire FAILED (stall timeout,
@@ -502,6 +516,15 @@ class AdmissionController:
             block = m.prefixed(f"ingest.{name}.")
             wait = m.timer(f"ingest.{name}.admission_wait")
             block["admission_wait_s"] = wait.total_s
+            # First-class percentiles off the bounded histogram the
+            # admit path observes into (ddl_tpu.obs) — the same values
+            # north_star_report's per-tenant dict surfaces.
+            block["admission_wait_p50_s"] = m.quantile(
+                f"ingest.{name}.admission_wait", 0.5
+            )
+            block["admission_wait_p99_s"] = m.quantile(
+                f"ingest.{name}.admission_wait", 0.99
+            )
             stall = wait.total_s / elapsed
             m.set_gauge(f"serve.stall.{name}", stall)
             block["stall_fraction"] = stall

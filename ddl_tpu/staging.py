@@ -55,6 +55,7 @@ from ddl_tpu.exceptions import (
     StallTimeoutError,
 )
 from ddl_tpu.faults import fault_point
+from ddl_tpu.obs import spans as obs_spans
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 
 logger = logging.getLogger("ddl_tpu")
@@ -340,7 +341,7 @@ TransferFn = Callable[[np.ndarray], Tuple[Any, Any]]
 class _Job:
     __slots__ = (
         "handle", "src", "transfer", "expected_crc", "claimed", "worker",
-        "alias_src",
+        "alias_src", "span_key",
     )
 
     def __init__(
@@ -350,6 +351,7 @@ class _Job:
         transfer: TransferFn,
         expected_crc: Optional[int] = None,
         alias_src: bool = False,
+        span_key: Optional[Tuple[int, int]] = None,
     ):
         self.handle = handle
         self.src = src
@@ -366,6 +368,10 @@ class _Job:
         #: per-transfer alias check guards clients that would zero-copy
         #: the slot pages into the device array.
         self.alias_src = alias_src
+        #: Window identity (producer_idx, seq) for lifecycle spans
+        #: (ddl_tpu.obs): the copy/transfer phases run on whichever
+        #: thread claims the job, so the key must travel WITH it.
+        self.span_key = span_key
         self.claimed = False
         #: True when the background worker (not a stealing consumer)
         #: executed the job — the signal adaptive consumers use to judge
@@ -440,6 +446,7 @@ class TransferExecutor:
         transfer: TransferFn,
         expected_crc: Optional[int] = None,
         alias_src: bool = False,
+        span_key: Optional[Tuple[int, int]] = None,
     ) -> StagedTransfer:
         """Enqueue one job: copy ``src`` into a pooled buffer, then run
         ``transfer`` on it.  ``src`` may be a live ring-slot view — the
@@ -458,6 +465,7 @@ class TransferExecutor:
         job = _Job(
             handle, src, transfer, expected_crc,
             alias_src=alias_src and not self.alias_unsafe,
+            span_key=span_key,
         )
         handle._job = job
         with self._cv:
@@ -629,6 +637,7 @@ class TransferExecutor:
     def _execute(self, job: _Job) -> None:
         """Run one claimed job to completion (worker or stealing thread)."""
         handle = job.handle
+        key = job.span_key or (None, None)
 
         def copy_phase():
             t0 = time.perf_counter()
@@ -650,10 +659,23 @@ class TransferExecutor:
             self.metrics.add_time(
                 "ingest.stage_copy", time.perf_counter() - t0
             )
+            obs_spans.record("staging.copy", key[0], key[1], t0)
 
         def transfer_phase():
             fault_point("staging.transfer")
-            return job.transfer(buf)
+            # Identity context + profiler lane for the nested transfer
+            # (put_window / batch put / ICI fan-out) — this phase runs
+            # on whichever thread claimed the job, so the jax.profiler
+            # annotation here is what lines the staged H2D up with the
+            # SpanLog's staging.transfer lane by name.
+            from ddl_tpu.profiling import annotate
+
+            obs_spans.set_window(*key)
+            try:
+                with annotate("ddl.staging_transfer"):
+                    return job.transfer(buf)
+            finally:
+                obs_spans.clear_window()
 
         try:
             if job.alias_src:
@@ -663,7 +685,9 @@ class TransferExecutor:
             self._retrying("copy", copy_phase)
             handle.copy_done.set()  # source released: slot may free
             try:
+                _span_t0 = obs_spans.t0()
                 value, base = self._retrying("transfer", transfer_phase)
+                obs_spans.record("staging.transfer", key[0], key[1], _span_t0)
             except (ShutdownRequested, KeyboardInterrupt):
                 raise
             except Exception:
@@ -702,9 +726,18 @@ class TransferExecutor:
         consumer that needed the value NOW anyway), never adds a host
         memcpy, and its span lands in ``ingest.transfer``.
         """
+        key = job.span_key or (None, None)
+
         def transfer_phase():
             fault_point("staging.transfer")
-            return job.transfer(job.src)
+            from ddl_tpu.profiling import annotate
+
+            obs_spans.set_window(*key)
+            try:
+                with annotate("ddl.staging_transfer"):
+                    return job.transfer(job.src)
+            finally:
+                obs_spans.clear_window()
 
         def salvage_slot(buf: Optional[np.ndarray] = None) -> None:
             """Terminal transfer failure with the slot STILL HELD (this
@@ -754,6 +787,7 @@ class TransferExecutor:
             return value
         _block_ready(base)
         self.metrics.add_time("ingest.transfer", time.perf_counter() - t0)
+        obs_spans.record("staging.transfer", key[0], key[1], t0)
         self.metrics.incr("staging.alias_windows")
         return value
 
@@ -858,9 +892,11 @@ class StagedIngestEngine:
         transfer: TransferFn,
         expected_crc: Optional[int] = None,
         alias_src: bool = False,
+        span_key: Optional[Tuple[int, int]] = None,
     ) -> StagedTransfer:
         return self.executor.submit(
-            src, transfer, expected_crc, alias_src=alias_src
+            src, transfer, expected_crc, alias_src=alias_src,
+            span_key=span_key,
         )
 
     def close(self) -> None:

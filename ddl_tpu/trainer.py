@@ -572,6 +572,8 @@ class Trainer:
         spans whose scan finished mid-acquire are not counted).
         """
         from ddl_tpu import Marker
+        from ddl_tpu.obs import spans as obs_spans
+        from ddl_tpu.profiling import annotate
         from ddl_tpu.utils import value_ready
 
         m = self.metrics
@@ -583,8 +585,10 @@ class Trainer:
             # window is already in flight while the previous scan runs,
             # so this wait stays near zero; it flows into
             # north_star_report["window_wait_s"] and the bench JSON.
+            # The annotation puts the same wait on the jax.profiler
+            # timeline, named to line up with the SpanLog lanes.
             t0 = time.perf_counter()
-            with m.timed("trainer.window_wait"):
+            with m.timed("trainer.window_wait"), annotate("ddl.window_wait"):
                 win = next(stream, _done)
             # Ready-by-default polarity: an unprobeable future must
             # never inflate the overlap measurement.
@@ -598,6 +602,8 @@ class Trainer:
                 break
             if window_hook is not None:
                 win = window_hook(win)
+            _span_t0 = obs_spans.t0()
+            _wkey = loader.last_window_key() or (None, None)
             state, losses = multi_for(win.shape[0])(
                 state, _window_cols(win, col_splits), per_step=True
             )
@@ -608,6 +614,9 @@ class Trainer:
             # re-serializing the loop the fused step exists to overlap.
             loss_mean = losses.mean()
             loader.gate_release_on(losses)
+            # Consume span = the scan DISPATCH (DDL020: the fused loop
+            # never waits on the device, so dispatch is all there is).
+            obs_spans.record("trainer.consume", *_wkey, _span_t0)
             m.incr("trainer.fused_windows")
             if pending is not None:
                 # Deferred ONE window: blocks on the PREVIOUS scan's
@@ -651,11 +660,15 @@ class Trainer:
         import jax
 
         from ddl_tpu import Marker
+        from ddl_tpu.obs import spans as obs_spans
+        from ddl_tpu.profiling import annotate
 
         epoch = start_epoch
         _done = object()
         while True:
-            with self.metrics.timed("trainer.window_wait"):
+            with self.metrics.timed("trainer.window_wait"), annotate(
+                "ddl.window_wait"
+            ):
                 win = next(stream, _done)
                 if win is not _done:
                     # "The window lands...": expose the whole transfer.
@@ -664,12 +677,17 @@ class Trainer:
                 break
             if window_hook is not None:
                 win = window_hook(win)
+            _span_t0 = obs_spans.t0()
+            _wkey = loader.last_window_key() or (None, None)
             state, losses = multi_for(win.shape[0])(
                 state, _window_cols(win, col_splits), per_step=True
             )
             # "...then compute runs to completion": immediate read-back
             # serializes the next acquire behind this scan.
             epoch_losses.append(float(losses.mean()))
+            # Consume span covers dispatch + the blocking read-back —
+            # the synchronous discipline's whole per-window compute.
+            obs_spans.record("trainer.consume", *_wkey, _span_t0)
             epoch += 1
             loader.mark(Marker.END_OF_EPOCH)
             if (

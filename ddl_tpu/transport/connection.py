@@ -315,6 +315,21 @@ class ConsumerConnection:
         # surviving ring is untouched by the producer's death.
         return reply
 
+    def try_recv_control(self, target: int) -> Any:
+        """Non-blocking receive of a producer-initiated control message
+        (today: ``ObsReport`` — the cross-process observability
+        shipping, ddl_tpu.obs).  Under the rejoin lock so a concurrent
+        elastic channel swap sees a consistent channel list; returns
+        :data:`NOTHING` when idle (or when the channel is already
+        broken — a dying producer's last report is best-effort)."""
+        with self._lock:
+            if self._finalized:
+                return NOTHING
+            try:
+                return self.channels[target].try_recv()
+            except (OSError, EOFError, ValueError):
+                return NOTHING
+
     def send_control(self, target: int, msg: Any) -> None:
         """Send a control-plane message to producer ``target`` (0-based
         ring index) under the rejoin lock — concurrent senders (the

@@ -142,6 +142,31 @@ class ShardAdoption:
     suspend_exchange: Optional[bool] = None
 
 
+@dataclasses.dataclass
+class ObsReport:
+    """Producer → consumer: one cross-process observability report
+    (:mod:`ddl_tpu.obs` aggregation).
+
+    Rides the same control channel as :class:`ReplayRequest` /
+    :class:`ShardAdoption`.  ``snapshot`` is the worker registry's
+    CUMULATIVE ``Metrics.snapshot()`` (so consumer-side merging is
+    replace-based and can never double-count), ``hists`` its
+    ``Metrics.hist_state()``, ``spans`` the armed SpanLog's event delta
+    since the last report (empty when tracing is disarmed).
+    ``report_idx`` is monotone per producer incarnation — the consumer
+    drops stale reports (the ShardAdoption epoch-fence pattern);
+    ``view_epoch`` carries the producer's cluster fence alongside.
+    """
+
+    producer_idx: int
+    report_idx: int
+    pid: int
+    snapshot: dict
+    hists: dict = dataclasses.field(default_factory=dict)
+    spans: list = dataclasses.field(default_factory=list)
+    view_epoch: int = 0
+
+
 @dataclasses.dataclass(frozen=True)
 class Topology:
     """Process/worker topology — the TPU-native replacement for ``MPI_Env``.

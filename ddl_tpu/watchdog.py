@@ -279,5 +279,19 @@ class Watchdog:
                         )
                 self.failures.append(reason)
                 self.metrics.incr("watchdog.failures")
+                # Post-mortem artifact (ddl_tpu.obs): a watchdog
+                # failure is terminal for the pipeline — dump the
+                # flight ring before on_failure escalates (no-op when
+                # no recorder is armed).
+                from ddl_tpu.obs.recorder import flight_dump
+
+                flight_dump(
+                    "watchdog.failure",
+                    # A stall-class failure is not per-producer; only a
+                    # death/respawn path identifies one.
+                    producer_idx=self._dead_idx,
+                    metrics=self.metrics,
+                    extra={"reason": reason},
+                )
                 self.on_failure(reason)
                 return

@@ -232,11 +232,22 @@ class TestNorthStarReport:
             "resilience_final_ckpts", "resilience_ckpt_submit_s",
             "resilience_ckpt_write_s", "resilience_ckpt_quarantined",
             "resilience_ckpt_cold_starts", "serve_revocations",
+            # end-to-end tracing extras (ISSUE 15: ddl_tpu.obs —
+            # histogram percentiles, per-stage breakdown,
+            # cross-process aggregation + flight-recorder health)
+            "window_latency_p50", "window_latency_p99",
+            "admission_wait_p99", "serve_tenant_admission_p99",
+            "stage_breakdown", "obs_reports_applied",
+            "obs_reports_stale", "obs_flight_dumps",
         }
         assert r["samples_per_sec"] > 0
         # The per-tenant stall block is a DICT keyed by tenant name
         # (empty when no tenancy ran), not a flat float.
         assert isinstance(r["serve_tenant_stall"], dict)
+        # So are the per-tenant admission p99s and the stage breakdown.
+        assert isinstance(r["serve_tenant_admission_p99"], dict)
+        assert isinstance(r["stage_breakdown"], dict)
+        assert "acquire_wait" in r["stage_breakdown"]
 
     def test_report_serve_block_reflects_tenancy(self):
         """The serve_* keys chart real scheduler/autoscaler activity."""

@@ -239,6 +239,22 @@ VIOLATIONS = {
         def save_train_state(state, path):
             np.save(path, state.params)      # straight to the final path
     """,
+    "DDL023": """
+        import collections
+
+        class SpanLog:
+            def __init__(self):
+                self._events = collections.deque()   # no maxlen bound
+
+            def record(self, ev):
+                self._events.append(ev)              # grows per event
+
+        class PrefetchIterator:
+            def __next__(self):
+                for sample in self._batch:
+                    obs_spans.record("s", 1, 2, 0.0)  # span per SAMPLE
+                return sample
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -557,6 +573,31 @@ CLEAN = {
         def helper_outside_config(path, data):
             with open(path, "w") as f:      # not a configured function
                 f.write(data)
+    """,
+    "DDL023": """
+        import collections
+
+        class SpanLog:
+            def __init__(self):
+                self._events = collections.deque(maxlen=1024)  # bounded
+                self._shipped = 0
+
+            def record(self, ev):
+                self._events.append(ev)     # bounded ring: drops oldest
+
+        class PrefetchIterator:
+            def __next__(self):
+                obs_spans.record("w", 1, 2, 0.0)   # per WINDOW: outside
+                for sample in self._batch:
+                    self._count += 1               # plain work is fine
+                return sample
+
+        class NotABufferClass:
+            def __init__(self):
+                self._items = []            # not in the configured set
+
+            def add(self, x):
+                self._items.append(x)
     """,
 }
 
@@ -926,6 +967,74 @@ class TestSelfTest:
         findings = lint_snippet(tmp_path, "DDL022", src)
         assert findings == [], findings
 
+    def test_ddl023_respects_configured_lists(self, tmp_path):
+        """Both halves are config-scoped: buffer classes and per-sample
+        hot functions outside the lists stay quiet; inside, they fire."""
+        src = """
+            import collections
+
+            class MyLog:
+                def __init__(self):
+                    self._ring = collections.deque()
+
+                def note(self, ev):
+                    self._ring.append(ev)
+
+            class MyFeed:
+                def pop(self):
+                    for s in self._batch:
+                        obs_spans.mark("s", 1, 2)
+                    return s
+        """
+        findings = lint_snippet(tmp_path, "DDL023", src)
+        assert findings == [], findings  # neither name is configured
+        cfg = LintConfig(
+            obs_event_buffer_classes=["MyLog"],
+            per_sample_hot_functions=["MyFeed.pop"],
+        )
+        findings = lint_snippet(tmp_path, "DDL023", src, config=cfg)
+        assert sorted(f.code for f in findings) == ["DDL023", "DDL023"]
+
+    def test_ddl023_sees_annotated_assignments(self, tmp_path):
+        """The shipped buffer classes construct their rings via
+        ANNOTATED assignments — an Assign-only pass would verify
+        nothing about the real tree (review catch, this PR)."""
+        src = """
+            import collections
+
+            class SpanLog:
+                def __init__(self):
+                    self._events: collections.deque = collections.deque()
+
+                def record(self, ev):
+                    self._events.append(ev)
+        """
+        findings = lint_snippet(tmp_path, "DDL023", src)
+        assert [f.code for f in findings] == ["DDL023"]
+        bounded = src.replace(
+            "collections.deque()", "collections.deque(maxlen=8)"
+        )
+        assert lint_snippet(tmp_path, "DDL023", bounded) == []
+
+    def test_ddl023_reconstruction_must_stay_bounded(self, tmp_path):
+        """A buffer bounded in __init__ but REBUILT unbounded elsewhere
+        (a reset() that forgets the maxlen) is still a finding."""
+        src = """
+            import collections
+
+            class SpanLog:
+                def __init__(self):
+                    self._events = collections.deque(maxlen=64)
+
+                def clear(self):
+                    self._events = collections.deque()   # bound lost
+
+                def record(self, ev):
+                    self._events.append(ev)
+        """
+        findings = lint_snippet(tmp_path, "DDL023", src)
+        assert [f.code for f in findings] == ["DDL023"]
+
     def test_nonexistent_config_file_is_an_error(self, tmp_path):
         f = tmp_path / "ok.py"
         f.write_text("x = 1\n")
@@ -1064,6 +1173,27 @@ class TestSuppressionAndConfig:
         assert "DDL010" in cfg.disable
         assert cfg.lock_order == ["a_lock", "b_lock"]
         assert "DDL010" not in cfg.enabled_codes()
+
+    def test_shipped_pyproject_loads_every_list_key(self):
+        """Every configured checker list in the REPO's pyproject must
+        survive load_config — a key parsed but never copied onto
+        LintConfig silently reverts its checker to defaults (the
+        wire_path_functions regression, PR 14)."""
+        import dataclasses
+
+        repo_cfg = load_config(REPO_ROOT / "pyproject.toml")
+        raw = _parse_toml_subset(
+            (REPO_ROOT / "pyproject.toml").read_text()
+        ).get("tool.ddl_lint", {})
+        field_names = {f.name for f in dataclasses.fields(repo_cfg)}
+        for key, val in raw.items():
+            if key in ("enable", "disable") or not isinstance(val, list):
+                continue
+            assert key in field_names, f"unknown [tool.ddl_lint] key {key}"
+            assert getattr(repo_cfg, key) == list(val), (
+                f"[tool.ddl_lint] {key} parsed from pyproject but not "
+                "loaded onto LintConfig (add it to load_config)"
+            )
 
 
 class TestGate:

@@ -35,7 +35,7 @@ ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/9] probe =="
+echo "== [1/10] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -45,23 +45,23 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/9] on-chip test suite =="
+echo "== [2/10] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/9] full bench =="
+echo "== [3/10] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/9] big-model MFU bench =="
+echo "== [4/10] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
 
-echo "== [4b/9] serving decode bench (small + big, MBU-graded) =="
+echo "== [4b/10] serving decode bench (small + big, MBU-graded) =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
   2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
-echo "== [5/9] stream-bandwidth diagnosis + window-size sweep =="
+echo "== [5/10] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
 # fail loudly (step timeout), never silently record CPU numbers in a
 # TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
@@ -81,7 +81,7 @@ for MIB in 64 128; do
     | tee "$ART/bench-stream-$MIB-$STAMP.json"
 done
 
-echo "== [6/9] ICI fan-out probe + distribution A/B =="
+echo "== [6/10] ICI fan-out probe + distribution A/B =="
 # Real remote-DMA numbers for the device-side distribution tier
 # (ddl_tpu/parallel/ici.py): per-hop bytes/s from the kernel probe,
 # then the ici-vs-xla A/B with link utilization against the per-link
@@ -92,7 +92,7 @@ DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_ici.py \
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici timeout 1200 python bench.py \
   2> "$ART/bench-ici-$STAMP.err" | tee "$ART/bench-ici-$STAMP.json"
 
-echo "== [7/9] distributed-optimizer probe + A/B =="
+echo "== [7/10] distributed-optimizer probe + A/B =="
 # The zero1/int8 measurement the ISSUE-8 artifact needs on real HBM:
 # state bytes/replica from placed shardings, the int8 gather leg on
 # real ICI, loss parity re-asserted on-chip.  Then the train_big MFU
@@ -108,7 +108,7 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big \
   2> "$ART/bench-big-zero1-$STAMP.err" \
   | tee "$ART/bench-big-zero1-$STAMP.json"
 
-echo "== [8/9] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
+echo "== [8/10] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
 # The fused compute/ingest step measured with REAL DMAs: (a) the
 # train-mode fit_stream leg carries the fused-vs-unfused A/B (on TPU
 # the unfused leg exposes the genuine H2D + ICI fan-out latency — no
@@ -130,7 +130,7 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=stream \
   2> "$ART/bench-fused-stream-$STAMP.err" \
   | tee "$ART/bench-fused-stream-$STAMP.json"
 
-echo "== [9/9] wire-format A/B on real ICI/DCN links (ISSUE 13) =="
+echo "== [9/10] wire-format A/B on real ICI/DCN links (ISSUE 13) =="
 # The wire tier re-measured where the links are real: (a) probe_wire on
 # the chip host prices encode/decode CPU against the REAL link speeds
 # (the break_even_link_mib_s table decides whether int8/bf16 pays off
@@ -151,5 +151,38 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici DDL_TPU_WIRE_DTYPE=int8 \
   timeout 1200 python bench.py \
   2> "$ART/bench-ici-wire-$STAMP.err" \
   | tee "$ART/bench-ici-wire-$STAMP.json"
+
+echo "== [10/10] fused-stream Perfetto trace + obs overhead (ISSUE 15) =="
+# One REAL fused-stream trace for the books: the obs A/B re-priced
+# where windows are genuinely DMA'd (the armed-vs-disarmed ceiling is
+# <= 2% on CPU; confirm it holds when the armed spans sit next to real
+# H2D/ICI dispatches), then a traced fused-stream run exported as
+# Chrome/Perfetto JSON — load chip-trace-$STAMP.json in
+# https://ui.perfetto.dev next to a jax.profiler capture of the same
+# run (the ddl.* annotation lanes and the SpanLog lanes line up by
+# name; docs/OBSERVABILITY.md "Reading a trace").
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=obs timeout 1200 python bench.py \
+  2> "$ART/bench-obs-$STAMP.err" | tee "$ART/bench-obs-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu timeout 900 python - "$ART/chip-trace-$STAMP.json" <<'PYEOF'
+import sys
+
+from bench import StreamBenchProducer, BATCH
+from ddl_tpu import DistributedDataLoader, Marker, distributed_dataloader
+from ddl_tpu.obs import spans as obs_spans
+
+out = sys.argv[1]
+with obs_spans.tracing() as slog:
+    @distributed_dataloader(n_producers=2, mode="thread", nslots=3)
+    def main(env):
+        loader = DistributedDataLoader(
+            StreamBenchProducer(), batch_size=BATCH,
+            connection=env.connection, n_epochs=12, output="jax",
+        )
+        for win in loader.windows(lookahead=2):
+            loader.mark(Marker.END_OF_EPOCH)
+    main()
+print(obs_spans.write_chrome_trace(slog.events(), out),
+      f"({len(slog.events())} events)")
+PYEOF
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="

@@ -1,0 +1,190 @@
+"""Observability-layer invariants: bounded event buffers, span-free
+per-sample loops.
+
+The tracing layer (``ddl_tpu/obs``) is only viable under two
+disciplines, both invisible to tests that pass (docs/LINT.md DDL023):
+
+1. **Every obs event buffer is bounded.**  An armed SpanLog or flight
+   ring lives for the whole run; an event buffer that grows per event
+   (``list.append``, ``deque()`` without ``maxlen``) eats the host on a
+   week-long job at exactly the moment observability matters most.
+   Classes named in ``obs_event_buffer_classes`` must only append to
+   attributes constructed as ``deque(maxlen=...)``.
+2. **Per-window spans, never per-sample.**  A span per window is a few
+   tuples a second; a span per sample at 200k samples/s is the observer
+   destroying the experiment.  Functions named in
+   ``per_sample_hot_functions`` (the per-sample fill/feed loops) may
+   not emit span events inside a loop body.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Span-emission API (ddl_tpu/obs/spans.py) — a call to one of these on
+#: a spans-module alias inside a per-sample loop is a finding.
+_SPAN_API = {"record", "mark", "t0", "set_window", "record_many"}
+
+#: Receiver names that identify the spans module / a span log object.
+_SPAN_BASES = {"spans", "obs_spans", "span_log", "slog", "_ARMED"}
+
+_GROW_CALLS = {"append", "extend", "appendleft", "extendleft"}
+
+
+def _deque_without_maxlen(node: ast.AST) -> bool:
+    """Is ``node`` a ``deque(...)`` / ``collections.deque(...)`` call
+    with no ``maxlen`` bound (positional second arg counts as bound)?"""
+    if not isinstance(node, ast.Call):
+        return False
+    if last_segment(node.func) != "deque":
+        return False
+    if len(node.args) >= 2:
+        return False  # deque(iterable, maxlen)
+    return all(kw.arg != "maxlen" for kw in node.keywords)
+
+
+def _unbounded_ctor(node: ast.AST) -> bool:
+    """[] / list() / dict-of-lists growth seeds / deque() without
+    maxlen — the constructors an event buffer must never use."""
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return True
+    if isinstance(node, ast.Call) and last_segment(node.func) == "list":
+        return True
+    return _deque_without_maxlen(node)
+
+
+@register
+class ObsPathDiscipline(Checker):
+    """DDL023: unbounded obs event buffers / per-sample span emission.
+
+    Escape hatch: ``# ddl-lint: disable=DDL023`` with a rationale (e.g.
+    a buffer bounded by an explicit trim the checker cannot see).
+    """
+
+    code = "DDL023"
+    summary = "unbounded obs event buffer / span emission per sample"
+
+    # -- half 1: bounded event buffers -------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        buf_classes = getattr(self.config, "obs_event_buffer_classes", [])
+        if node.name in buf_classes:
+            self._check_buffers(node)
+        self.generic_visit(node)
+
+    def _check_buffers(self, cls: ast.ClassDef) -> None:
+        # Pass 1: how is each self.<attr> constructed?  (any method —
+        # reset()-style reconstruction counts too; bounded wins only if
+        # EVERY construction site is bounded.)
+        ctor: Dict[str, bool] = {}  # attr -> every ctor bounded?
+        for fn in ast.walk(cls):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(fn):
+                # Plain AND annotated assignments: the shipped buffer
+                # classes use `self._events: deque = deque(maxlen=...)`
+                # — an Assign-only walk would never see them, and a
+                # later maxlen removal would ship with the lint green.
+                if isinstance(stmt, ast.Assign):
+                    targets, value = stmt.targets, stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and (
+                    stmt.value is not None
+                ):
+                    targets, value = [stmt.target], stmt.value
+                else:
+                    continue
+                for tgt in targets:
+                    attr = self._self_attr(tgt)
+                    if attr is None:
+                        continue
+                    if _unbounded_ctor(value):
+                        ctor[attr] = False
+                    elif isinstance(value, ast.Call) and (
+                        last_segment(value.func) == "deque"
+                    ):
+                        ctor.setdefault(attr, True)
+        # Pass 2: flag growth into attrs with any unbounded ctor.
+        for fn in ast.walk(cls):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                if not isinstance(call.func, ast.Attribute):
+                    continue
+                if call.func.attr not in _GROW_CALLS:
+                    continue
+                attr = self._self_attr(call.func.value)
+                if attr is not None and ctor.get(attr) is False:
+                    self.report(
+                        call,
+                        f"obs event buffer self.{attr} in "
+                        f"{cls.name} grows per event but was "
+                        "constructed without a bound — use "
+                        "deque(maxlen=...) so a forgotten armed "
+                        "log drops oldest events instead of eating "
+                        "the host",
+                    )
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    # -- half 2: no spans in per-sample loops ------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_hot_fn(node):
+            self._check_span_free_loops(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_hot_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "per_sample_hot_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_span_free_loops(self, fn: ast.AST) -> None:
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for stmt in loop.body + loop.orelse:
+                for call in ast.walk(stmt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if self._is_span_call(call):
+                        self.report(
+                            call,
+                            "span emission inside a loop of per-sample "
+                            f"hot function {fn.name}()"  # type: ignore[attr-defined]
+                            " — spans are per-WINDOW events; emit once "
+                            "outside the loop (the observer must not "
+                            "destroy the experiment)",
+                        )
+
+    @staticmethod
+    def _is_span_call(call: ast.Call) -> bool:
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        if call.func.attr not in _SPAN_API:
+            return False
+        base = call.func.value
+        return (
+            isinstance(base, ast.Name) and base.id in _SPAN_BASES
+        ) or (
+            isinstance(base, ast.Attribute) and base.attr in _SPAN_BASES
+        )

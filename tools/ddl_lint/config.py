@@ -47,6 +47,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL020",  # host sync inside a fused compute/ingest step function
     "DDL021",  # wire-path decode-then-requantize / unbounded codec call
     "DDL022",  # bare checkpoint write bypassing atomic temp+rename
+    "DDL023",  # unbounded obs event buffer / span emission per sample
 )
 
 
@@ -188,6 +189,27 @@ class LintConfig:
             "save_train_state",
             "_write_manifest",
             "AsyncCheckpointer._write_generation",
+        ]
+    )
+    #: Observability event-buffer classes (DDL023 half 1): every
+    #: event-growth site inside them must append to a
+    #: ``deque(maxlen=...)``-bounded attribute — an armed log on a
+    #: week-long run must drop oldest events, never eat the host.
+    obs_event_buffer_classes: List[str] = dataclasses.field(
+        default_factory=lambda: ["SpanLog", "FlightRecorder"]
+    )
+    #: Per-SAMPLE hot functions (DDL023 half 2): span emission inside
+    #: their loops is a finding — per-window spans are sanctioned,
+    #: per-sample spans at ingest rates destroy the experiment.
+    per_sample_hot_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "ArrayProducer._fill",
+            "FileShardProducer._load_next",
+            "WebDatasetProducer._fill",
+            "TokenStreamProducer._fill",
+            "PackedTokenProducer._fill",
+            "TFRecordTokenProducer._fill",
+            "PrefetchIterator.__next__",
         ]
     )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
@@ -370,6 +392,12 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.checkpoint_write_functions = str_list(
         "checkpoint_write_functions", cfg.checkpoint_write_functions
+    )
+    cfg.obs_event_buffer_classes = str_list(
+        "obs_event_buffer_classes", cfg.obs_event_buffer_classes
+    )
+    cfg.per_sample_hot_functions = str_list(
+        "per_sample_hot_functions", cfg.per_sample_hot_functions
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {
