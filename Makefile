@@ -2,12 +2,18 @@
 
 PY ?= python
 
-.PHONY: lint test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test
+.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
 lint:
 	$(PY) -m tools.ddl_lint ddl_tpu/ tests/
+
+# Whole-program verifier (tools/ddl_verify, docs/VERIFY.md): lock-order
+# graph + deadlock cycles (VP001), blocking-under-lock (VP002), the
+# env-knob contract (VP003), control-protocol exhaustiveness (VP004).
+verify:
+	$(PY) -m tools.ddl_verify ddl_tpu/
 
 # Full tier-1 suite (CPU-simulated 8-device mesh).
 test:
@@ -82,10 +88,11 @@ multihost:
 cluster-test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_cluster.py -q
 
-# The one-shot local gate: static analysis + bench JSON contract (the
-# bench-smoke contract includes the cache block's byte-identity and
-# >=2x warm-vs-cold assertions).
-check: lint bench-smoke
+# The one-shot local gate: static analysis (per-module lint +
+# whole-program verify) + bench JSON contract (the bench-smoke contract
+# includes the cache block's byte-identity and >=2x warm-vs-cold
+# assertions).
+check: lint verify bench-smoke
 
 # Chaos suite: deterministic fault matrix + randomized multi-fault soak
 # (includes slow PROCESS-mode spawns; docs/ROBUSTNESS.md) + the cache

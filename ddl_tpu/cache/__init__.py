@@ -34,6 +34,9 @@ from __future__ import annotations
 
 import os
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_lock
 from typing import Optional
 
 from ddl_tpu.cache.backends import (  # noqa: F401  (public re-exports)
@@ -74,7 +77,7 @@ __all__ = [
 def cache_enabled(override: Optional[bool] = None) -> bool:
     """The ``DDL_TPU_CACHE`` gate — default **off** (opt-in: the cache
     spends host RAM/disk, which is the operator's call)."""
-    return env_flag("DDL_TPU_CACHE", override, default="0")
+    return env_flag("DDL_TPU_CACHE", override)
 
 
 def warm_enabled(override: Optional[bool] = None) -> bool:
@@ -86,32 +89,26 @@ def warm_enabled(override: Optional[bool] = None) -> bool:
 def settings_from_env() -> dict:
     """The ``DDL_TPU_CACHE*`` knob set, parsed (one site; config.py's
     fields mirror these names minus the prefix)."""
-    spill_dir = os.environ.get("DDL_TPU_CACHE_SPILL_DIR") or None
+    spill_dir = envspec.raw("DDL_TPU_CACHE_SPILL_DIR") or None
     return {
-        "ram_budget_bytes": int(
-            os.environ.get("DDL_TPU_CACHE_RAM_MB", "256")
-        ) << 20,
+        "ram_budget_bytes": envspec.get("DDL_TPU_CACHE_RAM_MB") << 20,
         "spill_dir": spill_dir,
-        "spill_budget_bytes": int(
-            os.environ.get("DDL_TPU_CACHE_SPILL_MB", "1024")
-        ) << 20,
+        "spill_budget_bytes": envspec.get("DDL_TPU_CACHE_SPILL_MB") << 20,
         # Disk-tier codec (ddl_tpu.wire): spill entries stored
         # compressed under the same byte budget.  Empty/"none" = off.
-        "codec": (
-            os.environ.get("DDL_TPU_CACHE_CODEC", "") or None
-        ),
+        "codec": envspec.raw("DDL_TPU_CACHE_CODEC") or None,
     }
 
 
 def retry_settings_from_env() -> dict:
     return {
-        "retries": int(os.environ.get("DDL_TPU_CACHE_RETRIES", "3")),
-        "backoff_s": float(os.environ.get("DDL_TPU_CACHE_BACKOFF_S", "0.05")),
+        "retries": envspec.get("DDL_TPU_CACHE_RETRIES"),
+        "backoff_s": envspec.get("DDL_TPU_CACHE_BACKOFF_S"),
     }
 
 
 _default_store: Optional[CacheStore] = None
-_store_lock = threading.Lock()
+_store_lock = named_lock("cache.registry")
 
 
 def default_store() -> CacheStore:

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from ddl_tpu.concurrency import named_lock
 import time
 from typing import BinaryIO, Optional, Protocol, runtime_checkable
 
@@ -99,7 +101,7 @@ class ThrottledBackend:
         self.latency_s = float(latency_s)
         self.fail_every = int(fail_every)
         self._opens = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("cache.backend")
 
     # -- pickling (locks don't cross the spawn boundary) -------------------
 

@@ -43,6 +43,8 @@ import logging
 import os
 import struct
 import threading
+
+from ddl_tpu.concurrency import named_lock, named_rlock
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -149,8 +151,8 @@ class CacheStore:
         # Order (also declared in [tool.ddl_lint] lock_order): _lock may
         # be held when _spill_lock is taken (eviction spill-backstop),
         # never the reverse.
-        self._lock = threading.RLock()
-        self._spill_lock = threading.Lock()
+        self._lock = named_rlock("cache.store")
+        self._spill_lock = named_lock("cache.store.spill")
         # LRU: digest -> read-only decoded array; bounded by the byte
         # budget via _evict_over_budget (DDL013's whole point).
         self._ram: "collections.OrderedDict[str, np.ndarray]" = (

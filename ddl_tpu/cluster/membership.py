@@ -34,6 +34,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+
+from ddl_tpu.concurrency import named_lock, named_rlock
 import time
 from typing import (
     Any,
@@ -243,7 +245,7 @@ class LeaseTable:
     def __init__(self, lease_s: float = 5.0, clock: Callable[[], float] = time.monotonic):
         self.lease_s = float(lease_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("cluster.membership")
         # host_id -> lease deadline; bounded by the registered host set
         # (register/release are the only growth/shrink sites).
         self._deadline: Dict[int, float] = {}  # ddl-lint: disable=DDL013
@@ -337,7 +339,7 @@ class ClusterSupervisor:
         self._rank_listeners: List[Callable[[int], None]] = []
         self._departed_hosts: List[HostInfo] = []
         self._no_survivor_logged = False
-        self._lock = threading.RLock()
+        self._lock = named_rlock("cluster.supervisor")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.metrics.set_gauge("cluster.epoch", view.epoch)
@@ -468,7 +470,12 @@ class ClusterSupervisor:
             # sweep-crash discrimination (the view must either change
             # completely or not at all — new is computed before any
             # state mutates).
-            fault_point("cluster.view_change")
+            # The chaos site must sit INSIDE the critical section — it
+            # exists to crash/delay mid-view-change and prove the sweep
+            # sees all-or-nothing state.  fault_point is a disarmed
+            # no-op outside chaos tests, and an armed delay is bounded
+            # by the plan.
+            fault_point("cluster.view_change")  # ddl-verify: disable=VP002
             new = view_change(old, dead)
             if new is old:
                 return old

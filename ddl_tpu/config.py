@@ -61,6 +61,11 @@ class LoaderConfig:
     cache_spill_dir: Optional[str] = None
     cache_spill_mb: int = 1024
     cache_warm: bool = True
+    # Lossless codec for spilled cache entries ("" = raw bytes; "zlib"
+    # always available, "zstd"/"lz4" gated on the host library).  Was
+    # env-only (DDL_TPU_CACHE_CODEC) with no config mirror — the stale
+    # spawn-boundary drift ddl-verify VP003 now machine-checks.
+    cache_codec: str = ""
     # Wire format (ddl_tpu.wire; docs/PERF_NOTES.md "Wire format").
     # ``wire_dtype``: "" = no opinion (the per-reader capability
     # decides), "raw" = kill switch, "bf16"/"int8" = force the lossy
@@ -200,10 +205,16 @@ def _load_layered(cls: Any, path: Optional[str], overrides: dict) -> Any:
                 f"unknown config keys in {path}: {sorted(unknown)}"
             )
         values.update(loaded)
+    # Lazy: envspec imports this module to derive the knob families.
+    from ddl_tpu import envspec
+
     for field in dataclasses.fields(cls):
         if field.name.startswith("_"):
             continue
-        env = os.environ.get(cls._ENV_PREFIX + field.name.upper())
+        # envspec.raw fails loudly on an unregistered name, so a new
+        # config field cannot silently bypass the knob registry (the
+        # families auto-register from dataclasses.fields).
+        env = envspec.raw(cls._ENV_PREFIX + field.name.upper())
         if env is not None:
             values[field.name] = _coerce(env, field.type)
     values.update(overrides)

@@ -30,6 +30,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddl_tpu import envspec
 from ddl_tpu import integrity
 from ddl_tpu.datasetwrapper import ProducerFunctionSkeleton
 from ddl_tpu.exceptions import (
@@ -223,7 +224,7 @@ class DistributedDataLoader:
             getattr(r, "wire_dtype", "raw") or "raw" for r in replies
         ]
         self._shuffle_fraction = global_shuffle_fraction_exchange
-        self._max_replays = int(os.environ.get("DDL_TPU_MAX_REPLAYS", "2"))
+        self._max_replays = envspec.get("DDL_TPU_MAX_REPLAYS")
         # Per-target count of DISCARDED ring commits (quarantined slots +
         # stale in-flight successors dropped while waiting for a replay):
         # logical window seq = ring.released + held - skew.

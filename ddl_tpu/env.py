@@ -33,6 +33,7 @@ import os
 import threading
 from typing import Any, Callable, List, Optional
 
+from ddl_tpu import envspec
 from ddl_tpu.exceptions import ShutdownRequested, TransportError
 from ddl_tpu.faults import fault_point
 from ddl_tpu.transport.connection import (
@@ -75,7 +76,10 @@ def detect_host_identity(
        else 1 — the historical host==instance reading).
     """
     def _env_int(name: str) -> Optional[int]:
-        raw = os.environ.get(name)
+        # DDL_TPU names go through the registry; SLURM names are not
+        # ours to declare.
+        raw = (envspec.raw(name) if name.startswith("DDL_TPU_")
+               else os.environ.get(name))
         return int(raw) if raw not in (None, "") else None
 
     if host_id is None:
@@ -121,10 +125,10 @@ def detect_topology(
     share a host) comes from :func:`detect_host_identity`.
     """
     if mode is None:
-        mode = os.environ.get("DDL_TPU_MODE", RunMode.THREAD.value)
+        mode = envspec.get("DDL_TPU_MODE")
     mode = RunMode(mode) if not isinstance(mode, RunMode) else mode
     if n_producers is None:
-        n_producers = int(os.environ.get("DDL_TPU_N_PRODUCERS", "2"))
+        n_producers = envspec.get("DDL_TPU_N_PRODUCERS")
     if mode is RunMode.MULTIHOST:
         import jax
 
@@ -274,6 +278,10 @@ def _export_cache_knobs(config: Any) -> None:
         os.environ["DDL_TPU_CACHE_SPILL_DIR"] = config.cache_spill_dir
     else:
         os.environ.pop("DDL_TPU_CACHE_SPILL_DIR", None)
+    if getattr(config, "cache_codec", ""):
+        os.environ["DDL_TPU_CACHE_CODEC"] = config.cache_codec
+    else:
+        os.environ.pop("DDL_TPU_CACHE_CODEC", None)
 
 
 #: Cluster env vars THIS process exported from a config (never user-set
@@ -519,7 +527,7 @@ def distributed_dataloader(
         def wrapper(*args: Any, **kwargs: Any) -> Any:
             _export_cluster_knobs(config)
             topology = detect_topology(n_producers, mode, host_id, n_hosts)
-            depth = nslots or int(os.environ.get("DDL_TPU_NSLOTS", "2"))
+            depth = nslots or envspec.get("DDL_TPU_NSLOTS")
             _export_cache_knobs(config)
             _export_wire_knobs(config)
             workers = WorkerSet(topology, depth, shuffler_factory)

@@ -119,6 +119,9 @@ import enum
 import json
 import os
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_lock
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -214,7 +217,7 @@ class FaultPlan:
         self.specs = list(specs)
         self.seed = int(seed)
         self.fired: List[Tuple[str, str, Optional[int], int]] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.plan")
         # spec index -> matching hits: bounded by len(specs) by
         # construction (indices come only from enumerate(self.specs)).
         self._hits: Dict[int, int] = {}  # ddl-lint: disable=DDL013
@@ -439,7 +442,7 @@ class armed:
         self._prev_env: Optional[str] = None
 
     def __enter__(self) -> FaultPlan:
-        self._prev_env = os.environ.get(PLAN_ENV)
+        self._prev_env = envspec.raw(PLAN_ENV)
         self._prev = arm(self.plan, export=self.export)
         return self.plan
 
@@ -455,7 +458,7 @@ class armed:
 # Spawned producer processes (and any process launched with the env set)
 # arm themselves at import: ddl_tpu.datapusher imports this module, so
 # PROCESS-mode workers pick the plan up before their first window.
-_env_plan = os.environ.get(PLAN_ENV)
+_env_plan = envspec.raw(PLAN_ENV)
 if _env_plan:
     try:
         _ARMED = FaultPlan.from_json(_env_plan)

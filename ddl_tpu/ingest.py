@@ -23,6 +23,7 @@ from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddl_tpu import envspec
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.staging import StagedTransfer, staged_enabled
 
@@ -71,9 +72,7 @@ class DeviceIngestor:
         #: DDL_TPU_DISTRIBUTE env) picks ici on accelerator meshes and
         #: xla on the CPU client (where there is no ICI to control) —
         #: DDL_TPU_ICI_INGEST=0 is the auto-mode kill switch.
-        distribute = distribute or os.environ.get(
-            "DDL_TPU_DISTRIBUTE", "auto"
-        )
+        distribute = distribute or envspec.get("DDL_TPU_DISTRIBUTE")
         if distribute not in ("ici", "xla", "auto"):
             raise ValueError(
                 f"distribute must be ici|xla|auto, got {distribute!r}"
@@ -171,7 +170,7 @@ class DeviceIngestor:
             return True
         return (
             self._target_platform() != "cpu"
-            and os.environ.get("DDL_TPU_ICI_INGEST", "1") != "0"
+            and envspec.flag("DDL_TPU_ICI_INGEST")
         )
 
     def ici(self):

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import logging
 import os
+
+from ddl_tpu import envspec
 from typing import Any, Dict, Optional
 
 logger = logging.getLogger("ddl_tpu")
@@ -38,7 +40,7 @@ DEFAULT_SHIP_EVERY = 32
 
 def ship_every() -> int:
     """Windows between periodic worker ObsReports (0 = disabled)."""
-    raw = os.environ.get(SHIP_ENV, "")
+    raw = envspec.raw(SHIP_ENV) or ""
     if not raw:
         return DEFAULT_SHIP_EVERY
     try:

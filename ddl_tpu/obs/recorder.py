@@ -27,6 +27,9 @@ import json
 import logging
 import os
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_lock
 import time
 from collections import deque
 from typing import Any, Dict, Optional
@@ -62,10 +65,10 @@ class FlightRecorder:
     ):
         self.capacity = int(capacity)
         self._ring: deque = deque(maxlen=self.capacity)
-        self.directory = directory or os.environ.get(
-            FLIGHT_DIR_ENV, DEFAULT_FLIGHT_DIR
+        self.directory = (
+            directory or envspec.raw(FLIGHT_DIR_ENV) or DEFAULT_FLIGHT_DIR
         )
-        self._dump_lock = threading.Lock()
+        self._dump_lock = named_lock("obs.recorder.dump")
         self.dumps = 0
         self.noted = 0
         #: Paths written by this recorder (test/bench introspection).
@@ -195,8 +198,8 @@ class armed:
         self._prev_dir: Optional[str] = None
 
     def __enter__(self) -> FlightRecorder:
-        self._prev_env = os.environ.get(FLIGHT_ENV)
-        self._prev_dir = os.environ.get(FLIGHT_DIR_ENV)
+        self._prev_env = envspec.raw(FLIGHT_ENV)
+        self._prev_dir = envspec.raw(FLIGHT_DIR_ENV)
         self._prev = arm(self.rec, export=self.export)
         return self.rec
 
@@ -234,7 +237,7 @@ def flight_dump(
 
 # Spawned processes arm themselves at import when the consumer exported
 # a flight request (the faults.PLAN_ENV pattern).
-_env_flight = os.environ.get(FLIGHT_ENV)
+_env_flight = envspec.raw(FLIGHT_ENV)
 if _env_flight:
     try:
         _cap = int(_env_flight)

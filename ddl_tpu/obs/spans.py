@@ -42,6 +42,9 @@ import collections
 import json
 import os
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_lock
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -90,7 +93,7 @@ class SpanLog:
         self._events: collections.deque = collections.deque(
             maxlen=self.capacity
         )
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.spans")
         #: Total appends ever (monotone) — ``appended - len(events)``
         #: is the dropped-oldest count; exports surface it so a
         #: truncated trace is never mistaken for a complete one.
@@ -215,7 +218,7 @@ class tracing:
         self._prev_env: Optional[str] = None
 
     def __enter__(self) -> SpanLog:
-        self._prev_env = os.environ.get(TRACE_ENV)
+        self._prev_env = envspec.raw(TRACE_ENV)
         self._prev = arm(self.span_log, export=self.export)
         return self.span_log
 
@@ -404,7 +407,7 @@ def write_chrome_trace(events: Iterable[SpanEvent], path: str) -> str:
 # Spawned producer processes arm themselves at import when the consumer
 # exported a trace request (the faults.PLAN_ENV pattern): their span
 # batches ride ObsReport shipping back into the consumer's log.
-_env_trace = os.environ.get(TRACE_ENV)
+_env_trace = envspec.raw(TRACE_ENV)
 if _env_trace:
     try:
         _cap = int(_env_trace)

@@ -33,6 +33,8 @@ import collections
 import dataclasses
 import math
 import threading
+
+from ddl_tpu.concurrency import named_lock
 import time
 from typing import Dict, List, Optional
 
@@ -180,7 +182,7 @@ class Metrics:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics")
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._timers: Dict[str, Timer] = collections.defaultdict(Timer)
         self._gauges: Dict[str, float] = {}

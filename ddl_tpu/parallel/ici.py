@@ -45,6 +45,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddl_tpu import envspec
 from ddl_tpu.exceptions import ShutdownRequested
 from ddl_tpu.faults import fault_point
 from ddl_tpu.observability import Metrics, metrics as default_metrics
@@ -73,7 +74,7 @@ def fused_enabled(default: bool = True) -> bool:
     ``DDL_TPU_FUSED=0`` restores the synchronous discipline everywhere
     — the same path a latched DMA failure degrades to.
     """
-    val = os.environ.get("DDL_TPU_FUSED")
+    val = envspec.raw("DDL_TPU_FUSED")
     if val is None:
         return default
     return val != "0"

@@ -51,6 +51,8 @@ import logging
 import os
 import re
 import threading
+
+from ddl_tpu.concurrency import named_condition
 import time
 from typing import Any, List, Optional, Tuple
 
@@ -350,7 +352,7 @@ class AsyncCheckpointer:
         self.keep = int(keep)
         self.metrics = metrics or default_metrics()
         self.submit_timeout_s = float(submit_timeout_s)
-        self._cond = threading.Condition()
+        self._cond = named_condition("resilience.ckpt.cv")
         self._queue: List[Tuple[int, List[np.ndarray], Optional[dict]]] = []
         self._free: List[List[np.ndarray]] = []
         self._n_sets = 0

@@ -45,6 +45,9 @@ import logging
 import os
 import signal
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_rlock
 import time
 from typing import Any, Callable, Optional
 
@@ -99,9 +102,7 @@ class PreemptionGuard:
         clock: Callable[[], float] = time.monotonic,
     ):
         if deadline_s is None:
-            deadline_s = float(
-                os.environ.get(DEADLINE_ENV, DEFAULT_DEADLINE_S)
-            )
+            deadline_s = envspec.get(DEADLINE_ENV)
         if deadline_s <= 0:
             raise DDLError(
                 f"preemption deadline must be > 0, got {deadline_s}"
@@ -117,7 +118,7 @@ class PreemptionGuard:
         # bytecodes — with a plain Lock, a signal landing while that
         # same thread holds it (remaining() is called from every drain
         # rung) would deadlock notify() against its own frame.
-        self._lock = threading.RLock()
+        self._lock = named_rlock("resilience.guard")
         self._notice_t: Optional[float] = None
         self._reason = ""
         self._drained = False
@@ -217,7 +218,7 @@ class PreemptionGuard:
             self.notify("injected", deadline_s=n.deadline_s or None)
             self._flight_dump_once()
             return True
-        env = os.environ.get(NOTICE_ENV, "")
+        env = envspec.raw(NOTICE_ENV) or ""
         if env and env.lower() not in ("0", "off", "false"):
             try:
                 deadline = float(env)

@@ -53,6 +53,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+
+from ddl_tpu.concurrency import named_condition
 import time
 from typing import Callable, Dict, Optional
 
@@ -148,7 +150,7 @@ class FairShareScheduler:
         self.quantum_bytes = float(quantum_bytes)
         self.metrics = metrics or default_metrics()
         self._clock = clock
-        self._cond = threading.Condition()
+        self._cond = named_condition("serve.tenancy.cond")
         # name -> state: bounded by the registered tenant set
         # (register/unregister are the only growth/shrink sites).
         self._tenants: Dict[str, _TenantState] = {}  # ddl-lint: disable=DDL013

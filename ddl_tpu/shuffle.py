@@ -34,6 +34,8 @@ import logging
 import os
 import re
 import threading
+
+from ddl_tpu.concurrency import named_condition, named_lock
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -116,7 +118,7 @@ class Rendezvous:
     span = "thread"
 
     def __init__(self) -> None:
-        self._lock = threading.Condition()
+        self._lock = named_condition("shuffle.exchange.cond")
         self._boxes: Dict[Tuple[int, int, int], np.ndarray] = {}
         self._done: Dict[Tuple[int, int, int], np.ndarray] = {}
 
@@ -240,7 +242,7 @@ def _sweep_stale_sessions(root: str) -> None:
 
 #: Roots already swept by this process (sweep once per process+root).
 _swept_roots: set = set()
-_sweep_lock = threading.Lock()
+_sweep_lock = named_lock("shuffle.sweep")
 
 
 def make_session(prefix: str = "ddl") -> str:

@@ -43,6 +43,9 @@ import collections
 import logging
 import os
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_condition, named_lock
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
@@ -124,11 +127,11 @@ class StagingPool:
     ):
         self.metrics = metrics or default_metrics()
         self.max_per_key = (
-            int(os.environ.get("DDL_TPU_STAGING_POOL_CAP", DEFAULT_POOL_CAP))
+            envspec.get("DDL_TPU_STAGING_POOL_CAP")
             if max_per_key is None
             else max_per_key
         )
-        self._lock = threading.Lock()
+        self._lock = named_lock("staging.pool")
         # Free-lists hold at most max_per_key buffers per geometry key
         # (release() drops beyond the cap), and a run's batch geometries
         # are a small closed set — bounded by construction.
@@ -407,14 +410,12 @@ class TransferExecutor:
         self.pool = pool
         self.metrics = metrics or default_metrics()
         depth = (
-            int(os.environ.get("DDL_TPU_STAGING_QUEUE", DEFAULT_QUEUE_DEPTH))
+            envspec.get("DDL_TPU_STAGING_QUEUE")
             if max_queue is None
             else max_queue
         )
         self._max_queue = max(1, depth)
-        self._max_retries = int(
-            os.environ.get("DDL_TPU_STAGING_RETRIES", DEFAULT_MAX_RETRIES)
-        )
+        self._max_retries = envspec.get("DDL_TPU_STAGING_RETRIES")
         #: Set when a job exhausted its retry budget: the degradation
         #: ladder's "stop staging, go inline" latch, consulted by the
         #: lookahead consumers via ``StagedIngestEngine.faulted``.
@@ -426,7 +427,7 @@ class TransferExecutor:
         #: the memcpy saving only where it is safe.
         self.alias_unsafe = False
         self._dq: Deque[_Job] = collections.deque()
-        self._cv = threading.Condition()
+        self._cv = named_condition("staging.executor.cv")
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         #: The job the worker is currently executing (plain attribute:

@@ -21,6 +21,8 @@ import copy
 import logging
 import queue as queue_mod
 import threading
+
+from ddl_tpu.concurrency import named_rlock
 from typing import Any, List, Optional, Sequence
 
 from ddl_tpu.exceptions import StallTimeoutError, TransportError
@@ -201,7 +203,7 @@ class ConsumerConnection:
         # persistent state the replacement's bounded waits observe, so
         # whichever side wins the lock, the fresh worker still exits
         # promptly.
-        self._lock = threading.RLock()
+        self._lock = named_rlock("transport.connection")
         self._finalized = False
 
     @property

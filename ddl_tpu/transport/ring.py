@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import abc
 import threading
+
+from ddl_tpu.concurrency import named_condition
 import time
 from typing import Dict
 
@@ -145,7 +147,7 @@ class ThreadRing(WindowRing):
         self._committed = 0
         self._released = 0
         self._shutdown = False
-        self._cond = threading.Condition()
+        self._cond = named_condition("transport.ring.cond")
         self._prod_stall = 0.0
         self._cons_stall = 0.0
 

@@ -14,6 +14,9 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from ddl_tpu import envspec
+from ddl_tpu.concurrency import named_lock
 import time
 import uuid
 from pathlib import Path
@@ -31,7 +34,7 @@ from ddl_tpu.transport.ring import DEFAULT_TIMEOUT_S, WindowRing
 
 _CSRC = Path(__file__).parent / "csrc" / "shm_ring.cpp"
 _LIB_PATH = Path(__file__).parent / "csrc" / "_shm_ring.so"
-_build_lock = threading.Lock()
+_build_lock = named_lock("transport.shm.build")
 _lib: Optional[ctypes.CDLL] = None
 
 
@@ -156,7 +159,7 @@ _build_failure_logged = False
 
 
 def native_available() -> bool:
-    if os.environ.get("DDL_TPU_FORCE_PY_RING") == "1":
+    if envspec.flag("DDL_TPU_FORCE_PY_RING"):
         return False
     try:
         _load_native()
@@ -333,7 +336,7 @@ class PyShmRing(WindowRing):
         machine = platform.machine().lower()
         if (
             machine not in self._TSO_MACHINES
-            and os.environ.get("DDL_TPU_UNSAFE_PY_RING") != "1"
+            and not envspec.flag("DDL_TPU_UNSAFE_PY_RING")
         ):
             # Hard gate, not a docstring caveat (VERDICT r2 Weak #7): on
             # weakly-ordered ISAs (ARM64 etc.) Python-level stores can

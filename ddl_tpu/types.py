@@ -274,3 +274,15 @@ def normalize_splits(splits: Sequence[int] | int, n_values: int) -> tuple[int, .
             splits, f"splits must sum to n_values={n_values}, got sum={sum(splits)}"
         )
     return splits
+
+
+#: The cross-process control-channel protocol, declared as data so
+#: ``tools/ddl_verify`` VP004 can check dispatch exhaustiveness: every
+#: type listed here must have an ``isinstance`` arm in each configured
+#: dispatcher for its direction, and every type a dispatcher matches
+#: must be declared here (a new message class cannot ship half-wired).
+#: The consumer's ABORT broadcast is a ``str`` sentinel, not a class
+#: (``ddl_tpu.env.ABORT``) — it rides the same channel but is checked
+#: by the dispatchers' string arm, outside these tuples.
+CONSUMER_TO_PRODUCER_CONTROL = (ReplayRequest, ShardAdoption)
+PRODUCER_TO_CONSUMER_CONTROL = (ObsReport,)

@@ -22,17 +22,18 @@ from ddl_tpu.protocols import CALLBACK_POSITIONS
 logger = logging.getLogger("ddl_tpu")
 
 
-def env_flag(
-    name: str, override: Optional[bool] = None, default: str = "1"
-) -> bool:
+def env_flag(name: str, override: Optional[bool] = None) -> bool:
     """The repo's one boolean env-gate parser (``DDL_TPU_INTEGRITY``,
     ``DDL_TPU_STAGED``, ``DDL_TPU_TFRECORD_CRC``, ...): an explicit
     ``override`` wins; otherwise the variable is truthy unless set to
-    ``0``/``off``/``false`` (case-insensitive).  One shared falsy set —
-    per-module copies drifted."""
-    if override is not None:
-        return override
-    return os.environ.get(name, default).lower() not in ("0", "off", "false")
+    ``0``/``off``/``false`` (case-insensitive).  Delegates to the
+    :mod:`ddl_tpu.envspec` registry, which owns the default — an
+    unregistered name raises ``UnknownKnobError`` (the VP003 contract,
+    enforced at runtime too)."""
+    # Lazy: utils is imported everywhere, envspec pulls in config.
+    from ddl_tpu import envspec
+
+    return envspec.flag(name, override)
 
 
 def execute_callbacks(

@@ -46,6 +46,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ddl_tpu import envspec
 from ddl_tpu.exceptions import DecodeError
 from ddl_tpu.faults import fault_point
 
@@ -84,7 +85,7 @@ def resolve_wire_dtype(requested: Optional[str]) -> str:
     override — ``raw`` is the kill switch, a lossy value forces the
     tier on for A/B runs) wins over the per-reader capability
     (``ProducerFunctionSkeleton.wire_dtype``)."""
-    env = os.environ.get("DDL_TPU_WIRE_DTYPE")
+    env = envspec.raw("DDL_TPU_WIRE_DTYPE")
     if env is not None and env != "":
         return check_wire_dtype(env)
     return check_wire_dtype(requested)
@@ -98,7 +99,7 @@ def resolve_wire_codec(requested: Optional[str] = None) -> Optional[str]:
     Validated against the registry but NOT constructed — callers
     construct at use sites so a gated library fails where the bytes
     are, with the available set named."""
-    env = os.environ.get("DDL_TPU_WIRE_CODEC")
+    env = envspec.raw("DDL_TPU_WIRE_CODEC")
     name = env if env is not None and env != "" else requested
     if not name or name == "none":
         return None

@@ -85,6 +85,13 @@ VIOLATIONS = {
                 with _build_lock:       # inverts declared hierarchy
                     pass
     """,
+    "DDL024": """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()   # invisible to LOCK_ORDER
+    """,
     "DDL007": """
         def teardown(ch):
             try:
@@ -261,6 +268,9 @@ VIOLATIONS = {
 # the self-test structure tolerates it without weakening the exactness
 # check for everyone else).
 EXPECTED_EXTRA = {code: set() for code in VIOLATIONS}
+# DDL006's inversion fixture necessarily constructs bare primitives (the
+# checker keys on the lock_order variable names): DDL024 fires alongside.
+EXPECTED_EXTRA["DDL006"] = {"DDL024"}
 
 CLEAN = {
     "DDL001": """
@@ -302,13 +312,25 @@ CLEAN = {
     "DDL006": """
         import threading
 
-        _build_lock = threading.Lock()
-        _sweep_lock = threading.Lock()
+        # DDL006 keys on the VARIABLE names in config lock_order, so this
+        # fixture needs bare primitives (suppressed: the construction rule
+        # is DDL024's concern, tested by its own fixture pair).
+        _build_lock = threading.Lock()   # ddl-lint: disable=DDL024
+        _sweep_lock = threading.Lock()   # ddl-lint: disable=DDL024
 
         def rebuild():
             with _build_lock:
                 with _sweep_lock:       # declared order: build -> sweep
                     pass
+    """,
+    "DDL024": """
+        from ddl_tpu.concurrency import named_condition, named_lock
+
+        _registry_lock = named_lock("cache.registry")
+
+        class Pool:
+            def __init__(self):
+                self._cv = named_condition("staging.executor.cv")
     """,
     "DDL007": """
         def teardown(ch):

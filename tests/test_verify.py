@@ -1,0 +1,729 @@
+"""The verify gate: ddl-verify self-test + zero-findings gate + the
+runtime lock-order sanitizer.
+
+Four halves, mirroring ``tests/test_lint.py``:
+
+- **Self-test**: per-pass fixture trees, each containing exactly one
+  violation, asserting every ``VP00x`` pass actually fires (a silently
+  dead pass would let the gate rot into a no-op), plus clean
+  counterparts, plus suppression/config-layer tests.  Fixtures pass an
+  explicit :class:`VerifyConfig` (with ``lock_order`` /
+  ``registered_knobs`` overrides) so repo policy cannot mask a
+  regressed pass.
+- **Gate**: ``run_paths(["ddl_tpu"])`` with the repo config must return
+  zero findings.
+- **Reflection**: the committed ``docs/CONFIG.md`` matches the knob
+  registry, the registry validates against the config dataclasses, and
+  VP003's *static* parse of the registry agrees with the *imported*
+  one — so the analyzer can never drift from the runtime contract.
+- **Sanitizer**: deterministic two-thread inversion repro (strict and
+  recording modes), measured zero cost disarmed, and a chaos-matrix
+  drain under an armed sanitizer.
+"""
+
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:  # tools.* import under any pytest cwd
+    sys.path.insert(0, str(REPO_ROOT))
+
+from ddl_tpu import concurrency, envspec  # noqa: E402
+from ddl_tpu.concurrency import (  # noqa: E402
+    LOCK_ORDER,
+    LockOrderViolation,
+    named_condition,
+    named_lock,
+    named_rlock,
+)
+from tools.ddl_verify.config import (  # noqa: E402
+    ALL_PASSES,
+    VerifyConfig,
+    load_config,
+)
+from tools.ddl_verify.passes import PASS_REGISTRY  # noqa: E402
+from tools.ddl_verify.runner import run_paths  # noqa: E402
+
+
+def write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def only_pass(code, **kw):
+    return VerifyConfig(enable=[code], **kw)
+
+
+_LOCK_PRELUDE = """
+    from ddl_tpu.concurrency import named_condition, named_lock
+
+    _a = named_lock("a")
+    _b = named_lock("b")
+"""
+
+
+class TestVP001LockOrder:
+    def test_lexical_inversion_fires(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": _LOCK_PRELUDE + """
+    def f():
+        with _b:
+            with _a:          # inverts the declared a-before-b order
+                pass
+    """})
+        cfg = only_pass("VP001", lock_order=["a", "b"])
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP001"]
+        assert "inverts LOCK_ORDER" in findings[0].message
+
+    def test_interprocedural_inversion_fires(self, tmp_path):
+        # The edge VP001 exists for: each function is individually
+        # clean; the inversion only appears across the call.
+        root = write_tree(tmp_path, {"m.py": _LOCK_PRELUDE + """
+    def helper():
+        with _a:
+            pass
+
+    def f():
+        with _b:
+            helper()
+    """})
+        cfg = only_pass("VP001", lock_order=["a", "b"])
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP001"]
+        assert "via call" in findings[0].message
+
+    def test_cross_module_cycle_fires(self, tmp_path):
+        # Neither declared-order direction is violated in one place the
+        # order can see (c is unranked... both ranked here): build a
+        # genuine a->b / b->a cycle split across two modules.
+        root = write_tree(tmp_path, {
+            "locks.py": _LOCK_PRELUDE,
+            "one.py": """
+    from locks import _a, _b
+
+    def fwd():
+        with _a:
+            with _b:
+                pass
+    """,
+            "two.py": """
+    from locks import _a, _b
+
+    def rev():
+        with _b:
+            with _a:
+                pass
+    """,
+        })
+        cfg = only_pass("VP001", lock_order=["a", "b"])
+        findings = run_paths([root], config=cfg)
+        msgs = [f.message for f in findings]
+        assert any("cycle" in m for m in msgs), msgs
+        assert any("inverts LOCK_ORDER" in m for m in msgs), msgs
+
+    def test_unranked_lock_fires(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu.concurrency import named_lock
+
+    _c = named_lock("stray.lock")
+    """})
+        cfg = only_pass("VP001", lock_order=["a", "b"])
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP001"]
+        assert "missing from LOCK_ORDER" in findings[0].message
+
+    def test_compliant_nesting_is_clean(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": _LOCK_PRELUDE + """
+    def helper():
+        with _b:
+            pass
+
+    def f():
+        with _a:
+            with _b:
+                pass
+        with _a:
+            helper()
+    """})
+        cfg = only_pass("VP001", lock_order=["a", "b"])
+        assert run_paths([root], config=cfg) == []
+
+    def test_missing_declared_order_fails_loud(self, tmp_path):
+        # Locks but no LOCK_ORDER anywhere: the contract itself is
+        # missing, which must be a finding, not a silent clean pass.
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu.concurrency import named_lock
+
+    _a = named_lock("a")
+    """})
+        cfg = only_pass("VP001", concurrency_module="absent.py")
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP001"]
+        assert "no LOCK_ORDER" in findings[0].message
+
+
+class TestVP002Blocking:
+    def test_untimed_wait_under_other_lock_fires(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu.concurrency import named_condition, named_lock
+
+    _l = named_lock("a")
+    _cv = named_condition("b")
+
+    def f():
+        with _l:
+            with _cv:
+                _cv.wait()  # releases b, still parks holding a
+    """})
+        # The wait releases _cv but NOT _l: flagged against 'a'.
+        findings = run_paths([root], config=only_pass("VP002"))
+        assert [f.code for f in findings] == ["VP002"]
+        assert "'a'" in findings[0].message
+
+    def test_interprocedural_sleep_fires(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    import time
+
+    from ddl_tpu.concurrency import named_lock
+
+    _l = named_lock("a")
+
+    def backoff():
+        time.sleep(0.5)
+
+    def f():
+        with _l:
+            backoff()
+    """})
+        findings = run_paths([root], config=only_pass("VP002"))
+        assert [f.code for f in findings] == ["VP002"]
+        assert "backoff" in findings[0].message
+
+    def test_held_condition_wait_and_timed_calls_are_clean(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu.concurrency import named_condition, named_lock
+
+    _l = named_lock("a")
+    _cv = named_condition("b")
+
+    def f(q, worker):
+        with _cv:
+            _cv.wait(0.5)
+            _cv.wait_for(lambda: True, timeout=0.5)
+        with _l:
+            q.get(timeout=1.0)
+            worker.join(timeout=2.0)
+            _cv.notify_all()
+    """})
+        assert run_paths([root], config=only_pass("VP002")) == []
+
+    def test_untimed_wait_on_the_held_condition_is_clean(self, tmp_path):
+        # cond.wait() on the condition currently held releases it — the
+        # one sanctioned unbounded park.
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu.concurrency import named_condition
+
+    _cv = named_condition("b")
+
+    def f():
+        with _cv:
+            _cv.wait()
+    """})
+        assert run_paths([root], config=only_pass("VP002")) == []
+
+    def test_depth_limit_respected(self, tmp_path):
+        src = """
+    import time
+
+    from ddl_tpu.concurrency import named_lock
+
+    _l = named_lock("a")
+
+    def three():
+        time.sleep(0.5)
+
+    def two():
+        three()
+
+    def one():
+        two()
+
+    def f():
+        with _l:
+            one()
+    """
+        root = write_tree(tmp_path, {"m.py": src})
+        deep = only_pass("VP002", blocking_depth=3)
+        assert [f.code for f in run_paths([root], config=deep)] == ["VP002"]
+        shallow = only_pass("VP002", blocking_depth=1)
+        assert run_paths([root], config=shallow) == []
+
+
+class TestVP003EnvContract:
+    def test_unregistered_accessor_read_fires(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu import envspec
+
+    def f():
+        return envspec.raw("DDL_TPU_NOT_A_KNOB")
+    """})
+        cfg = only_pass("VP003", registered_knobs=["DDL_TPU_GOOD"])
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP003"]
+        assert "not registered" in findings[0].message
+
+    def test_raw_environ_read_fires_even_when_registered(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    import os
+
+    def f():
+        return os.environ.get("DDL_TPU_GOOD")
+    """})
+        cfg = only_pass("VP003", registered_knobs=["DDL_TPU_GOOD"])
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP003"]
+        assert "bypasses the envspec registry" in findings[0].message
+
+    def test_constant_indirection_is_resolved(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    import os
+
+    _ENV = "DDL_TPU_SNEAKY"
+
+    def f():
+        return os.getenv(_ENV)
+    """})
+        cfg = only_pass("VP003", registered_knobs=["DDL_TPU_GOOD"])
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP003"]
+        assert "DDL_TPU_SNEAKY" in findings[0].message
+
+    def test_export_drift_fires(self, tmp_path):
+        # The flagship VP003 claim: a knob registered with
+        # export="cache" but missing from _export_cache_knobs is the
+        # stale spawn mirror that silently strands PROCESS workers.
+        root = write_tree(tmp_path, {
+            "spec.py": """
+    def _K(name, **kw):
+        return name
+
+    A = _K("DDL_TPU_X", export="cache")
+    B = _K("DDL_TPU_Y", export="cache")
+    """,
+            "env.py": """
+    import os
+
+    from ddl_tpu.utils import env_flag
+
+    def _export_cache_knobs(env):
+        env["DDL_TPU_X"] = "1"      # DDL_TPU_Y forgotten
+
+    def reader():
+        return env_flag("DDL_TPU_X"), env_flag("DDL_TPU_Y")
+    """,
+        })
+        cfg = only_pass(
+            "VP003", envspec_module="spec.py", config_module="absent.py",
+        )
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP003"]
+        assert "_export_cache_knobs does not mirror" in findings[0].message
+        assert "DDL_TPU_Y" in findings[0].message
+
+    def test_dead_registration_fires(self, tmp_path):
+        root = write_tree(tmp_path, {"spec.py": """
+    def _K(name, **kw):
+        return name
+
+    A = _K("DDL_TPU_NOBODY_READS_ME")
+    """})
+        cfg = only_pass(
+            "VP003", envspec_module="spec.py", config_module="absent.py",
+        )
+        findings = run_paths([root], config=cfg)
+        assert [f.code for f in findings] == ["VP003"]
+        assert "never read" in findings[0].message
+
+    def test_registered_reads_are_clean(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    from ddl_tpu import envspec
+    from ddl_tpu.utils import env_flag
+
+    def f():
+        return envspec.get("DDL_TPU_GOOD"), env_flag("DDL_TPU_GOOD")
+    """})
+        cfg = only_pass("VP003", registered_knobs=["DDL_TPU_GOOD"])
+        assert run_paths([root], config=cfg) == []
+
+
+_TYPES_FIXTURE = """
+    class Ping:
+        pass
+
+    class Pong:
+        pass
+
+    CONSUMER_TO_PRODUCER_CONTROL = (Ping, Pong)
+    PRODUCER_TO_CONSUMER_CONTROL = ()
+"""
+
+
+class TestVP004Protocol:
+    def _cfg(self):
+        return only_pass(
+            "VP004",
+            types_module="types_fx.py",
+            consumer_to_producer_dispatchers=["DataPusher._poll_control"],
+            producer_to_consumer_dispatchers=[],
+        )
+
+    def test_missing_dispatch_arm_fires(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "types_fx.py": _TYPES_FIXTURE,
+            "pusher.py": """
+    class DataPusher:
+        def _poll_control(self, msg):
+            if isinstance(msg, Ping):
+                return 1
+            return None        # Pong silently dropped
+    """,
+        })
+        findings = run_paths([root], config=self._cfg())
+        assert [f.code for f in findings] == ["VP004"]
+        assert "no isinstance arm" in findings[0].message
+        assert "Pong" in findings[0].message
+
+    def test_undeclared_dispatch_arm_fires(self, tmp_path):
+        types_only_ping = _TYPES_FIXTURE.replace("(Ping, Pong)", "(Ping,)")
+        root = write_tree(tmp_path, {
+            "types_fx.py": types_only_ping,
+            "pusher.py": """
+    class DataPusher:
+        def _poll_control(self, msg):
+            if isinstance(msg, (Ping, Pong)):
+                return 1
+            return None
+    """,
+        })
+        findings = run_paths([root], config=self._cfg())
+        assert [f.code for f in findings] == ["VP004"]
+        assert "not declared" in findings[0].message
+
+    def test_missing_protocol_tuple_fails_loud(self, tmp_path):
+        root = write_tree(tmp_path, {"types_fx.py": """
+    class Ping:
+        pass
+    """})
+        findings = run_paths([root], config=self._cfg())
+        assert {f.code for f in findings} == {"VP004"}
+        assert any("declaration missing" in f.message for f in findings)
+
+    def test_missing_dispatcher_fails_loud(self, tmp_path):
+        root = write_tree(tmp_path, {"types_fx.py": _TYPES_FIXTURE})
+        findings = run_paths([root], config=self._cfg())
+        assert any(
+            "DataPusher._poll_control" in f.message
+            and "not found" in f.message
+            for f in findings
+        )
+
+    def test_exhaustive_dispatch_is_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "types_fx.py": _TYPES_FIXTURE,
+            "pusher.py": """
+    class DataPusher:
+        def _poll_control(self, msg):
+            if isinstance(msg, Ping):
+                return 1
+            if isinstance(msg, Pong):
+                return 2
+            return None
+    """,
+        })
+        assert run_paths([root], config=self._cfg()) == []
+
+
+class TestConfigAndSuppression:
+    def test_inline_pragma_suppresses(self, tmp_path):
+        root = write_tree(tmp_path, {"m.py": """
+    import os
+
+    def f():
+        # justified: fixture demonstrating the verify pragma grammar
+        return os.getenv("DDL_TPU_X")  # ddl-verify: disable=VP003
+    """})
+        cfg = only_pass("VP003", registered_knobs=["DDL_TPU_X"])
+        assert run_paths([root], config=cfg) == []
+
+    def test_lint_pragma_does_not_leak_into_verify(self, tmp_path):
+        # The two tools share the suppression grammar but not the tag:
+        # a ddl-LINT pragma must not silence a VERIFY finding.
+        root = write_tree(tmp_path, {"m.py": """
+    import os
+
+    def f():
+        return os.getenv("DDL_TPU_X")  # ddl-lint: disable=VP003
+    """})
+        cfg = only_pass("VP003", registered_knobs=["DDL_TPU_X"])
+        assert [f.code for f in run_paths([root], config=cfg)] == ["VP003"]
+
+    def test_per_path_ignores(self, tmp_path):
+        root = write_tree(tmp_path, {"vendored/m.py": """
+    import os
+
+    def f():
+        return os.getenv("DDL_TPU_X")
+    """})
+        cfg = only_pass(
+            "VP003", registered_knobs=["DDL_TPU_X"],
+            per_path_ignores={str(tmp_path / "vendored"): ["VP003"]},
+        )
+        assert run_paths([root], config=cfg) == []
+
+    def test_parse_failure_surfaces_as_vp000(self, tmp_path):
+        root = write_tree(tmp_path, {"broken.py": "def f(:\n"})
+        findings = run_paths([root], config=only_pass("VP001"))
+        assert [f.code for f in findings] == ["VP000"]
+
+    def test_repo_config_enables_all_passes(self):
+        cfg = load_config(REPO_ROOT / "pyproject.toml")
+        assert cfg.enabled_passes() == list(ALL_PASSES)
+        assert set(PASS_REGISTRY) == set(ALL_PASSES)
+
+    def test_unknown_path_fails_loud(self):
+        with pytest.raises(FileNotFoundError):
+            run_paths([str(REPO_ROOT / "no_such_dir")],
+                      config=VerifyConfig())
+
+
+class TestGate:
+    def test_tree_is_clean(self):
+        """THE gate: the shipped tree must verify clean under the repo
+        config.  Any reintroduced inversion, blocking-under-lock,
+        unregistered knob, or dropped protocol arm fails tier-1 here."""
+        findings = run_paths([str(REPO_ROOT / "ddl_tpu")])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_gate_would_catch_a_regression(self, tmp_path):
+        """The gate's teeth end to end: copying one real module and
+        inverting one real lock pair does NOT verify clean."""
+        victim = tmp_path / "regressed.py"
+        victim.write_text(textwrap.dedent("""
+            from ddl_tpu.concurrency import named_lock
+
+            _store = named_lock("cache.store")
+            _reg = named_lock("cache.registry")
+
+            def evict():
+                with _store:
+                    with _reg:      # registry ranks before store
+                        pass
+        """))
+        cfg = load_config(REPO_ROOT / "pyproject.toml")
+        findings = run_paths(
+            [str(REPO_ROOT / "ddl_tpu"), str(tmp_path)], config=cfg
+        )
+        assert any(
+            f.code == "VP001" and "inverts LOCK_ORDER" in f.message
+            for f in findings
+        ), findings
+
+
+class TestReflection:
+    def test_config_md_matches_registry(self):
+        committed = (REPO_ROOT / "docs" / "CONFIG.md").read_text()
+        assert committed == envspec.render_table(), (
+            "docs/CONFIG.md is stale — regenerate with "
+            "`python -m ddl_tpu.envspec > docs/CONFIG.md`"
+        )
+
+    def test_registry_validates_against_config_dataclasses(self):
+        envspec.validate()
+
+    def test_static_registry_parse_matches_import(self):
+        """VP003's no-import parse of envspec.py must see exactly the
+        knobs the imported registry serves — otherwise the analyzer
+        checks a contract the runtime doesn't."""
+        import ast
+
+        from tools.ddl_verify.passes.envknobs import parse_registry
+        from tools.ddl_verify.project import ModuleInfo, build_index
+
+        mods = []
+        for f in sorted((REPO_ROOT / "ddl_tpu").rglob("*.py")):
+            rel = str(f.relative_to(REPO_ROOT))
+            src = f.read_text()
+            mods.append(ModuleInfo(path=rel, source=src,
+                                   tree=ast.parse(src)))
+        registered, groups, external, _ = parse_registry(
+            build_index(mods), "ddl_tpu/envspec.py", "ddl_tpu/config.py"
+        )
+        assert registered == set(envspec.REGISTRY)
+        want_groups = {}
+        for k in envspec.REGISTRY.values():
+            if k.export:
+                want_groups.setdefault(k.export, set()).add(k.name)
+        assert groups == want_groups
+        assert external == {
+            k.name for k in envspec.REGISTRY.values()
+            if k.external and not k.config_field and not k.train_field
+        }
+
+    def test_every_rank_has_a_construction_site(self):
+        """LOCK_ORDER must not accrete stale names: every declared rank
+        corresponds to a named_* construction in the tree, and vice
+        versa (the vice-versa half is VP001's unranked-lock check)."""
+        import ast
+
+        from tools.ddl_verify.project import ModuleInfo, build_index
+
+        mods = []
+        for f in sorted((REPO_ROOT / "ddl_tpu").rglob("*.py")):
+            src = f.read_text()
+            mods.append(ModuleInfo(path=str(f), source=src,
+                                   tree=ast.parse(src)))
+        constructed = {name for name, _, _ in build_index(mods).lock_sites}
+        assert constructed == set(LOCK_ORDER)
+
+    def test_unknown_knob_fails_loud_at_runtime(self):
+        with pytest.raises(envspec.UnknownKnobError):
+            envspec.raw("DDL_TPU_NOT_A_KNOB")
+
+
+class TestSanitizer:
+    def test_two_thread_inversion_is_reproduced(self):
+        """Deterministic repro: thread A runs the compliant order,
+        thread B the inverted one (strictly sequenced so the test can
+        never actually deadlock); the recording sanitizer names the
+        inverted pair, the thread, and the held stack."""
+        with concurrency.sanitized(order=("outer", "inner")) as san:
+            lo, li = named_lock("outer"), named_lock("inner")
+            a_done = threading.Event()
+
+            def compliant():
+                with lo:
+                    with li:
+                        pass
+                a_done.set()
+
+            def inverted():
+                a_done.wait(5.0)
+                with li:
+                    with lo:
+                        pass
+
+            ta = threading.Thread(target=compliant, name="compliant")
+            tb = threading.Thread(target=inverted, name="inverted")
+            ta.start(), tb.start()
+            ta.join(5.0), tb.join(5.0)
+        assert len(san.violations) == 1
+        acquiring, holding, thread, stack = san.violations[0]
+        assert (acquiring, holding) == ("outer", "inner")
+        assert thread == "inverted"
+        assert stack == ("inner",)
+        assert ("outer", "inner") in san.edges  # compliant order, observed
+
+    def test_strict_mode_raises_at_the_inversion_site(self):
+        with concurrency.sanitized(order=("outer", "inner"),
+                                   strict=True) as san:
+            lo, li = named_lock("outer"), named_lock("inner")
+            with li:
+                with pytest.raises(LockOrderViolation):
+                    lo.acquire()
+        assert len(san.violations) == 1
+
+    def test_rlock_reentrancy_and_condition_wait_are_not_inversions(self):
+        with concurrency.sanitized(order=("outer", "inner")) as san:
+            rl = named_rlock("outer")
+            cv = named_condition("inner")
+            with rl:
+                with rl:  # reentrant same-name: no order claim
+                    with cv:
+                        cv.wait(0.01)
+                        # the wait popped+re-pushed "inner"; taking it
+                        # again on another thread's behalf would be the
+                        # bug — here the stack must be intact:
+                        assert not san.violations
+        assert san.violations == []
+
+    def test_disarmed_factories_return_raw_primitives(self):
+        assert concurrency.armed_sanitizer() is None
+        assert type(named_lock("cache.store")) is type(threading.Lock())
+        assert type(named_rlock("cache.store")) is type(threading.RLock())
+        assert type(named_condition("x")) is threading.Condition
+
+    def test_disarmed_cost_is_zero(self):
+        """The disarmed factory hands back the raw primitive, so the
+        per-acquire cost is *identical* by construction; measure it
+        anyway so a wrapper can never sneak in.  Best-of-7 to damp
+        scheduler noise; the bound is generous because CI boxes jitter,
+        but a real proxy layer costs 3-5x and would trip it."""
+        disarmed = named_lock("cache.store")
+        raw = threading.Lock()  # ddl-lint: disable=DDL024
+
+        def best_of(lock, n=20000, reps=7):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    lock.acquire()
+                    lock.release()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        ratio = best_of(disarmed) / best_of(raw)
+        assert ratio < 2.0, f"disarmed named_lock costs {ratio:.2f}x raw"
+
+
+class TestSanitizerChaos:
+    def test_chaos_drain_under_armed_sanitizer(self):
+        """A chaos-matrix row with the sanitizer armed: the full
+        THREAD-mode drain under a producer slowdown fault must be
+        byte-identical AND inversion-free — every fault interleaving
+        doubles as a lock-order witness.  A deliberate inversion under
+        the same armed sanitizer IS caught (the leg is non-vacuous)."""
+        from test_faults import (
+            FaultKind,
+            FaultPlan,
+            FaultSpec,
+            assert_byte_identical,
+            drain_numpy,
+        )
+
+        plan = FaultPlan([
+            FaultSpec("producer.fill", FaultKind.PRODUCER_SLOWDOWN,
+                      at=2, count=2, param=0.02),
+        ])
+        with concurrency.sanitized() as san:
+            windows, wd, _ = drain_numpy(plan, n_epochs=3)
+            assert_byte_identical(windows, 3)
+            assert list(wd.failures) == []
+            assert san.n_acquisitions > 0, "armed run watched no locks"
+            assert san.violations == [], san.violations
+            # Every order actually observed during the drain must agree
+            # with the static contract VP001 checks.
+            for top, name in san.edges:
+                r_top = concurrency._RANK.get(top)
+                r_name = concurrency._RANK.get(name)
+                if r_top is not None and r_name is not None:
+                    assert r_top <= r_name, (top, name)
+            # ... and the same armed sanitizer catches a deliberate
+            # inversion of two real data-plane names:
+            conn = named_lock("transport.connection")
+            ring = named_condition("transport.ring.cond")
+            with ring:
+                with conn:
+                    pass
+            assert any(
+                v[0] == "transport.connection"
+                and v[1] == "transport.ring.cond"
+                for v in san.violations
+            ), san.violations

@@ -9,6 +9,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     fused_step,
     ingest_path,
     jax_hazards,
+    locks,
     obs_path,
     producer_fill,
     protocol,

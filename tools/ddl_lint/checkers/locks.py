@@ -1,0 +1,87 @@
+"""Lock-identity invariant (DDL024).
+
+The whole-program analyzer (``tools/ddl_verify``) and the runtime
+``LockOrderSanitizer`` key everything — the acquisition graph, the
+declared ``LOCK_ORDER``, the inversion witnesses — on lock *names*.  An
+anonymous ``threading.Lock()`` is invisible to all of it: its
+acquisitions cannot be ranked, its inversions render as ``<locked
+_thread.lock object>``.  So bare construction of the stdlib primitives
+is a finding everywhere except the factory module itself
+(``[tool.ddl_lint] lock_factory_modules``); real code constructs through
+``ddl_tpu.concurrency.named_lock`` / ``named_rlock`` /
+``named_condition``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+_PRIMITIVES = {"Lock", "RLock", "Condition"}
+
+_FACTORY_FOR = {
+    "Lock": "named_lock",
+    "RLock": "named_rlock",
+    "Condition": "named_condition",
+}
+
+
+@register
+class BareLockConstruction(Checker):
+    """DDL024: threading primitives must be constructed with an identity.
+
+    Flags ``threading.Lock()`` / ``threading.RLock()`` /
+    ``threading.Condition()`` (attribute form, or bare names the module
+    imported from ``threading``) outside the configured factory modules.
+    The factories return the raw primitive disarmed, so compliance costs
+    nothing at runtime — it buys the name the static lock-order graph
+    and the armed sanitizer need.
+    """
+
+    code = "DDL024"
+    summary = "bare threading.Lock()/RLock()/Condition() without identity"
+
+    def __init__(self, ctx, config):
+        super().__init__(ctx, config)
+        rel = ctx.path.replace("\\", "/")
+        self._exempt = any(
+            rel == mod or rel.endswith("/" + mod)
+            for mod in config.lock_factory_modules
+        )
+        # Names this module imported from threading itself — a bare
+        # `Condition()` only counts when it is the stdlib one.
+        self._from_threading = {
+            alias.asname or alias.name
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.ImportFrom)
+            and node.module == "threading"
+            for alias in node.names
+        }
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if not self._exempt:
+            name = self._primitive_name(node.func)
+            if name is not None:
+                self.report(
+                    node,
+                    f"bare threading.{name}() has no identity the "
+                    "lock-order graph or sanitizer can see; construct "
+                    f"via ddl_tpu.concurrency.{_FACTORY_FOR[name]}"
+                    '("<subsystem.name>") (zero-cost disarmed)',
+                )
+        self.generic_visit(node)
+
+    def _primitive_name(self, func: ast.AST):
+        if isinstance(func, ast.Attribute):
+            if (
+                func.attr in _PRIMITIVES
+                and last_segment(func.value) == "threading"
+            ):
+                return func.attr
+            return None
+        if isinstance(func, ast.Name):
+            if func.id in _PRIMITIVES and func.id in self._from_threading:
+                return func.id
+        return None

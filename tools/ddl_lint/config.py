@@ -48,6 +48,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL021",  # wire-path decode-then-requantize / unbounded codec call
     "DDL022",  # bare checkpoint write bypassing atomic temp+rename
     "DDL023",  # unbounded obs event buffer / span emission per sample
+    "DDL024",  # bare threading.Lock()/RLock()/Condition() without identity
 )
 
 
@@ -212,6 +213,12 @@ class LintConfig:
             "PrefetchIterator.__next__",
         ]
     )
+    #: Modules allowed to construct bare threading primitives — the
+    #: named-lock factory itself (DDL024 exempts these; everything else
+    #: constructs through ``ddl_tpu.concurrency.named_*``).
+    lock_factory_modules: List[str] = dataclasses.field(
+        default_factory=lambda: ["ddl_tpu/concurrency.py"]
+    )
     #: path-prefix (repo-relative, '/'-separated) -> codes ignored under it.
     per_path_ignores: Dict[str, List[str]] = dataclasses.field(
         default_factory=dict
@@ -232,17 +239,19 @@ class LintConfig:
 _SECTION = "tool.ddl_lint"
 
 
-def _parse_toml_subset(text: str) -> Dict[str, Dict[str, object]]:
-    """Parse just enough TOML for ``[tool.ddl_lint]`` tables.
+def _parse_toml_subset(
+    text: str, section: str = _SECTION
+) -> Dict[str, Dict[str, object]]:
+    """Parse just enough TOML for one ``[tool.<name>]`` section family.
 
     Handles ``[section]`` headers and ``key = <literal>`` lines where the
     literal is a (possibly multi-line) array of strings, a quoted string,
-    or a boolean.  Everything outside ``tool.ddl_lint*`` sections is
-    skipped without parsing, so the rest of pyproject.toml may use any
-    TOML feature.
+    or a boolean.  Everything outside ``<section>*`` tables is skipped
+    without parsing, so the rest of pyproject.toml may use any TOML
+    feature.  ``tools/ddl_verify`` reuses this with its own section.
     """
     tables: Dict[str, Dict[str, object]] = {}
-    section = None
+    cur = None
     pending_key: Optional[str] = None
     pending_val = ""
     for raw in text.splitlines():
@@ -254,7 +263,7 @@ def _parse_toml_subset(text: str) -> Dict[str, Dict[str, object]]:
         if pending_key is not None:
             pending_val += " " + line
             if _literal_complete(pending_val):
-                tables[section][pending_key] = _eval_literal(pending_val)
+                tables[cur][pending_key] = _eval_literal(pending_val)
                 pending_key = None
             continue
         if not line or line.startswith("#"):
@@ -262,13 +271,13 @@ def _parse_toml_subset(text: str) -> Dict[str, Dict[str, object]]:
         m = re.match(r"^\[([^\]]+)\]$", line)
         if m:
             name = m.group(1).strip()
-            if name == _SECTION or name.startswith(_SECTION + "."):
-                section = name
-                tables.setdefault(section, {})
+            if name == section or name.startswith(section + "."):
+                cur = name
+                tables.setdefault(cur, {})
             else:
-                section = None
+                cur = None
             continue
-        if section is None:
+        if cur is None:
             continue
         m = re.match(r"^([A-Za-z0-9_./\"'*-]+)\s*=\s*(.*)$", line)
         if not m:
@@ -276,7 +285,7 @@ def _parse_toml_subset(text: str) -> Dict[str, Dict[str, object]]:
         key = m.group(1).strip().strip("\"'")
         val = m.group(2).strip()
         if _literal_complete(val):
-            tables[section][key] = _eval_literal(val)
+            tables[cur][key] = _eval_literal(val)
         else:  # array continues on following lines
             pending_key, pending_val = key, val
     return tables
@@ -317,24 +326,27 @@ def _eval_literal(val: str) -> object:
         return val  # bare string; tolerated rather than fatal
 
 
-def _load_tables(pyproject: Path) -> Dict[str, Dict[str, object]]:
+def _load_tables(
+    pyproject: Path, section: str = _SECTION
+) -> Dict[str, Dict[str, object]]:
     text = pyproject.read_text()
+    tool_key = section.split(".", 1)[1]  # "tool.ddl_lint" -> "ddl_lint"
     try:
         import tomllib  # Python 3.11+
 
         data = tomllib.loads(text)
-        tool = data.get("tool", {}).get("ddl_lint")
+        tool = data.get("tool", {}).get(tool_key)
         if tool is None:
             return {}
-        tables: Dict[str, Dict[str, object]] = {_SECTION: {}}
+        tables: Dict[str, Dict[str, object]] = {section: {}}
         for k, v in tool.items():
             if isinstance(v, dict):
-                tables[f"{_SECTION}.{k}"] = dict(v)
+                tables[f"{section}.{k}"] = dict(v)
             else:
-                tables[_SECTION][k] = v
+                tables[section][k] = v
         return tables
     except ModuleNotFoundError:
-        return _parse_toml_subset(text)
+        return _parse_toml_subset(text, section)
 
 
 def find_pyproject(start: Path) -> Optional[Path]:
@@ -398,6 +410,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.per_sample_hot_functions = str_list(
         "per_sample_hot_functions", cfg.per_sample_hot_functions
+    )
+    cfg.lock_factory_modules = str_list(
+        "lock_factory_modules", cfg.lock_factory_modules
     )
     ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
     cfg.per_path_ignores = {

@@ -20,13 +20,13 @@ class Finding:
         return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
 
 
-def render_report(findings: Iterable[Finding]) -> str:
+def render_report(findings: Iterable[Finding], tool: str = "ddl-lint") -> str:
     """Stable, grep-friendly report: one `path:line:col: CODE msg` per
     finding, sorted by location, with a trailing count line."""
     ordered: List[Finding] = sorted(findings)
     lines = [f.render() for f in ordered]
     n = len(ordered)
     lines.append(
-        "ddl-lint: clean" if n == 0 else f"ddl-lint: {n} finding(s)"
+        f"{tool}: clean" if n == 0 else f"{tool}: {n} finding(s)"
     )
     return "\n".join(lines)

@@ -16,12 +16,12 @@ from typing import Dict, Set, Tuple
 _TAG = "ddl-lint:"
 
 
-def _parse_comment(comment: str) -> Set[str]:
+def _parse_comment(comment: str, tag: str = _TAG) -> Set[str]:
     """Extract suppressed codes from one comment string, or empty set."""
     text = comment.lstrip("#").strip()
-    if not text.startswith(_TAG):
+    if not text.startswith(tag):
         return set()
-    rest = text[len(_TAG):].strip()
+    rest = text[len(tag):].strip()
     if not rest.startswith("disable"):
         return set()
     _, _, codes = rest.partition("=")
@@ -36,11 +36,15 @@ def _parse_comment(comment: str) -> Set[str]:
     return out
 
 
-def collect_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+def collect_suppressions(
+    source: str, tag: str = _TAG
+) -> Tuple[Dict[int, Set[str]], Set[str]]:
     """Map line -> suppressed codes, plus file-wide suppressed codes.
 
     Tokenizes rather than regexes so that ``ddl-lint: disable=...`` inside
-    a string literal is not treated as a pragma.
+    a string literal is not treated as a pragma.  ``tag`` selects the
+    pragma namespace — ``tools/ddl_verify`` reuses this machinery with
+    ``tag="ddl-verify:"`` so its pragmas and ddl-lint's stay disjoint.
     """
     per_line: Dict[int, Set[str]] = {}
     file_wide: Set[str] = set()
@@ -51,7 +55,7 @@ def collect_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
         return per_line, file_wide
     for tok in tokens:
         if tok.type == tokenize.COMMENT:
-            codes = _parse_comment(tok.string)
+            codes = _parse_comment(tok.string, tag)
             if not codes:
                 continue
             line = tok.start[0]

@@ -1,0 +1,26 @@
+"""ddl-verify — whole-program static analysis for ddl_tpu.
+
+Where ``tools/ddl_lint`` checks one function body at a time, ddl-verify
+parses all of ``ddl_tpu/`` once, builds a cross-module call graph and a
+lock-acquisition graph (keyed on the ``ddl_tpu.concurrency`` named-lock
+identities), and runs interprocedural passes:
+
+- **VP001** — lock-order violations and deadlock cycles across
+  functions and modules (the gap DDL006/DDL008 cannot see), checked
+  against the declared ``LOCK_ORDER``.
+- **VP002** — blocking calls reachable while holding a lock
+  (``.wait()``/``.join()``/``.acquire()``/``.recv``/``sleep``/...),
+  with a timed-call allowlist.
+- **VP003** — the env-knob contract: every ``DDL_TPU_*`` read resolves
+  through the ``ddl_tpu.envspec`` registry, every spawn-boundary
+  ``_export_*_knobs`` mirror covers its registered group, and nothing
+  registered is dead.
+- **VP004** — cross-process protocol exhaustiveness: every declared
+  control-channel message type has a dispatch arm, and every dispatch
+  arm matches a declared type.
+
+Run: ``python -m tools.ddl_verify [--json] [paths ...]`` (wired into
+``make verify`` / ``make check``).  Suppress a sanctioned finding with
+``# ddl-verify: disable=VP00x`` plus a rationale comment.  docs/VERIFY.md
+documents each pass with repo examples.
+"""

@@ -1,0 +1,68 @@
+"""CLI: ``python -m tools.ddl_verify [paths ...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  Parse failures surface
+as VP000 findings (exit 1) rather than crashing the run.  ``--json``
+emits machine-readable findings for CI annotation tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tools.ddl_lint.findings import render_report
+from tools.ddl_verify.passes import PASS_REGISTRY
+from tools.ddl_verify.runner import run_paths
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ddl_verify",
+        description="ddl_tpu whole-program concurrency + contract verifier",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["ddl_tpu"],
+        help="files or directories to analyze (default: ddl_tpu)",
+    )
+    parser.add_argument(
+        "--config", metavar="PYPROJECT",
+        help="explicit pyproject.toml (default: nearest above first path)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON list instead of the text report",
+    )
+    parser.add_argument(
+        "--list-checks", action="store_true",
+        help="list pass codes and summaries, then exit",
+    )
+    args = parser.parse_args(argv)
+    if args.list_checks:
+        for code in sorted(PASS_REGISTRY):
+            print(f"{code}  {PASS_REGISTRY[code].summary}")
+        return 0
+    try:
+        findings = run_paths(args.paths, config_file=args.config)
+    except (OSError, ValueError) as e:
+        print(f"ddl-verify: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(
+            [
+                {
+                    "path": f.path, "line": f.line, "col": f.col,
+                    "code": f.code, "message": f.message,
+                }
+                for f in findings
+            ],
+            indent=2,
+        ))
+    else:
+        print(render_report(findings, tool="ddl-verify"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Verify configuration: ``[tool.ddl_verify]`` loading.
+
+Reuses ddl-lint's 3.10-safe TOML-subset machinery (parameterised by
+section).  Most fields default to the repo's real layout; self-test
+fixtures override them directly so repo policy cannot mask a regressed
+pass (the ``tests/test_lint.py`` pattern).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from tools.ddl_lint.config import _load_tables, find_pyproject
+
+ALL_PASSES: Tuple[str, ...] = ("VP001", "VP002", "VP003", "VP004")
+
+_SECTION = "tool.ddl_verify"
+
+
+@dataclasses.dataclass
+class VerifyConfig:
+    enable: List[str] = dataclasses.field(
+        default_factory=lambda: list(ALL_PASSES)
+    )
+    disable: List[str] = dataclasses.field(default_factory=list)
+    #: Module (repo-relative) declaring ``LOCK_ORDER`` and the
+    #: ``named_*`` factories.  VP001 parses the order from it.
+    concurrency_module: str = "ddl_tpu/concurrency.py"
+    #: Explicit lock order override (outermost first).  Empty = parse
+    #: ``LOCK_ORDER`` from ``concurrency_module`` (fixtures set this).
+    lock_order: List[str] = dataclasses.field(default_factory=list)
+    #: Module holding the ``_K("DDL_TPU_...")`` knob registry.
+    envspec_module: str = "ddl_tpu/envspec.py"
+    #: Module whose dataclasses derive the DDL_TPU_<FIELD> families.
+    config_module: str = "ddl_tpu/config.py"
+    #: Explicit registered-knob override (fixtures); empty = parse.
+    registered_knobs: List[str] = dataclasses.field(default_factory=list)
+    #: Module declaring the control-protocol tuples.
+    types_module: str = "ddl_tpu/types.py"
+    #: Dispatcher functions (``Class.method``) per protocol direction.
+    consumer_to_producer_dispatchers: List[str] = dataclasses.field(
+        default_factory=lambda: ["DataPusher._poll_control"]
+    )
+    producer_to_consumer_dispatchers: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "DistributedDataLoader._drain_obs_once",
+        ]
+    )
+    #: Attribute-call names VP002 treats as non-blocking even under a
+    #: lock: bounded/polling primitives and pure notifications.
+    blocking_allowed: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "try_recv", "notify", "notify_all", "poll",
+        ]
+    )
+    #: Interprocedural depth for VP002's reachability (call hops from
+    #: the lock-holding body to the blocking primitive).
+    blocking_depth: int = 3
+    #: path-prefix -> pass codes ignored under it.
+    per_path_ignores: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def enabled_passes(self) -> List[str]:
+        return [c for c in self.enable if c not in set(self.disable)]
+
+    def ignored_for(self, rel_path: str) -> set:
+        rel = rel_path.replace("\\", "/")
+        out: set = set()
+        for prefix, codes in self.per_path_ignores.items():
+            if rel.startswith(prefix.rstrip("/") + "/") or rel == prefix:
+                out.update(codes)
+        return out
+
+
+def load_config(pyproject: Optional[Path]) -> VerifyConfig:
+    cfg = VerifyConfig()
+    if pyproject is None or not pyproject.is_file():
+        return cfg
+    tables = _load_tables(pyproject, _SECTION)
+    main = tables.get(_SECTION, {})
+
+    def str_list(key: str, cur: List[str]) -> List[str]:
+        v = main.get(key)
+        if isinstance(v, (list, tuple)) and all(isinstance(s, str) for s in v):
+            return list(v)
+        return cur
+
+    cfg.enable = str_list("enable", cfg.enable)
+    cfg.disable = str_list("disable", cfg.disable)
+    cfg.lock_order = str_list("lock_order", cfg.lock_order)
+    cfg.registered_knobs = str_list("registered_knobs", cfg.registered_knobs)
+    cfg.blocking_allowed = str_list("blocking_allowed", cfg.blocking_allowed)
+    cfg.consumer_to_producer_dispatchers = str_list(
+        "consumer_to_producer_dispatchers",
+        cfg.consumer_to_producer_dispatchers,
+    )
+    cfg.producer_to_consumer_dispatchers = str_list(
+        "producer_to_consumer_dispatchers",
+        cfg.producer_to_consumer_dispatchers,
+    )
+    for key in ("concurrency_module", "envspec_module", "config_module",
+                "types_module"):
+        v = main.get(key)
+        if isinstance(v, str):
+            setattr(cfg, key, v)
+    v = main.get("blocking_depth")
+    if isinstance(v, int) and not isinstance(v, bool):
+        cfg.blocking_depth = v
+    ignores = tables.get(f"{_SECTION}.per_path_ignores", {})
+    cfg.per_path_ignores = {
+        str(k): [str(c) for c in v]
+        for k, v in ignores.items()
+        if isinstance(v, (list, tuple))
+    }
+    return cfg
+
+
+__all__ = ["ALL_PASSES", "VerifyConfig", "find_pyproject", "load_config"]

@@ -1,0 +1,11 @@
+"""Pass registry: importing this package registers every pass."""
+
+from tools.ddl_verify.passes.base import PASS_REGISTRY, Pass, register
+from tools.ddl_verify.passes import (  # noqa: F401  (registration imports)
+    blocking,
+    envknobs,
+    lock_graph,
+    protocol,
+)
+
+__all__ = ["PASS_REGISTRY", "Pass", "register"]

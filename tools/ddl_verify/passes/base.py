@@ -1,0 +1,49 @@
+"""Pass base class and registry (the ddl-lint checker shape, one level
+up: a pass sees the whole :class:`ProjectIndex`, not one module)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Type
+
+from tools.ddl_lint.findings import Finding
+from tools.ddl_verify.config import VerifyConfig
+from tools.ddl_verify.project import ProjectIndex
+
+
+class Pass:
+    """One whole-program pass producing findings for a single code."""
+
+    code: str = ""
+    summary: str = ""
+
+    def __init__(self, index: ProjectIndex, config: VerifyConfig):
+        self.index = index
+        self.config = config
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        raise NotImplementedError
+
+    def report(self, path: str, node_or_line, message: str) -> None:
+        if isinstance(node_or_line, ast.AST):
+            line = getattr(node_or_line, "lineno", 1)
+            col = getattr(node_or_line, "col_offset", 0) + 1
+        else:
+            line, col = int(node_or_line), 1
+        self.findings.append(
+            Finding(path=path, line=line, col=col, code=self.code,
+                    message=message)
+        )
+
+
+PASS_REGISTRY: Dict[str, Type[Pass]] = {}
+
+
+def register(cls: Type[Pass]) -> Type[Pass]:
+    if not cls.code:
+        raise ValueError(f"{cls.__name__} has no code")
+    if cls.code in PASS_REGISTRY:
+        raise ValueError(f"duplicate pass code {cls.code}")
+    PASS_REGISTRY[cls.code] = cls
+    return cls

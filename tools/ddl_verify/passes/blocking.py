@@ -1,0 +1,207 @@
+"""VP002 — blocking calls reachable while holding a lock.
+
+A lock-holding body that parks on a peer (untimed ``.wait()``/
+``.join()``/``.acquire()``, ``.recv``, ``.admit``, queue ``.get()``,
+``time.sleep``) serializes every other thread needing that lock behind
+an event that may never come — the convoy/deadlock shape one hop beyond
+what per-function DDL012 can see.  The pass walks each ``with <lock>:``
+body and flags blocking primitives reached directly or through up to
+``blocking_depth`` resolvable call hops.
+
+Sanctioned shapes:
+
+- a *timed* call (any positional timeout or ``timeout=``/``deadline=``
+  keyword) — bounded waits are the repo's discipline (DDL012);
+- ``cond.wait(...)`` on the condition **currently held** — the wait
+  releases that lock by design;
+- names in ``blocking_allowed`` (``try_recv``, ``notify``, ...);
+- ``# ddl-verify: disable=VP002`` with a rationale, for waits the
+  analysis cannot see are bounded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.ddl_verify.passes.base import Pass, register
+from tools.ddl_verify.project import FunctionInfo, last_segment
+
+_TIMEOUT_KWARGS = {"timeout", "timeout_s", "deadline", "deadline_s"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg in _TIMEOUT_KWARGS for kw in call.keywords)
+
+
+class _Site:
+    __slots__ = ("desc", "line", "recv")
+
+    def __init__(self, desc: str, line: int, recv: Optional[ast.AST]):
+        self.desc, self.line, self.recv = desc, line, recv
+
+
+@register
+class BlockingUnderLock(Pass):
+    code = "VP002"
+    summary = "blocking call reachable while holding a lock"
+
+    def run(self):
+        self._direct_memo: Dict[int, List[_Site]] = {}
+        self._reach_memo: Dict[Tuple[int, int], List[str]] = {}
+        allowed = set(self.config.blocking_allowed)
+        self._allowed = allowed
+        for infos in self.index.functions.values():
+            for fn in infos:
+                for stmt in fn.node.body:
+                    self._scan(fn, stmt, [])
+        return self.findings
+
+    # -- direct blocking sites in one function ----------------------------
+
+    def _direct_sites(self, fn: FunctionInfo) -> List[_Site]:
+        key = id(fn.node)
+        if key in self._direct_memo:
+            return self._direct_memo[key]
+        sites: List[_Site] = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                site = self._classify(node)
+                if site is not None:
+                    sites.append(site)
+        self._direct_memo[key] = sites
+        return sites
+
+    def _classify(self, call: ast.Call) -> Optional[_Site]:
+        """A :class:`_Site` if this call can block indefinitely."""
+        func = call.func
+        name = last_segment(func)
+        if name in self._allowed or name is None:
+            return None
+        line = call.lineno
+        if name == "sleep":
+            # Sleeping while holding a lock is dead time for every
+            # waiter even when bounded.
+            return _Site("time.sleep", line, None)
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        if name in ("wait", "wait_for"):
+            n_timeout_pos = 1 if name == "wait" else 2
+            if len(call.args) < n_timeout_pos and not _has_timeout(call):
+                return _Site(f".{name}() untimed", line, recv)
+            return None
+        if name == "join":
+            if not call.args and not _has_timeout(call):
+                return _Site(".join() untimed", line, recv)
+            return None
+        if name == "acquire":
+            # acquire(False)/acquire(blocking=False) is a try-lock;
+            # any positional arg or timeout kwarg bounds it.
+            if call.args or _has_timeout(call):
+                return None
+            if any(kw.arg == "blocking" for kw in call.keywords):
+                return None
+            return _Site(".acquire() untimed", line, recv)
+        if name == "get":
+            only_block_kw = all(kw.arg == "block" for kw in call.keywords)
+            if not call.args and not _has_timeout(call) and only_block_kw:
+                return _Site(".get() untimed", line, recv)
+            return None
+        if name == "recv":
+            return _Site(".recv()", line, recv)
+        if name == "admit":
+            if not _has_timeout(call):
+                return _Site(".admit() untimed", line, recv)
+            return None
+        return None
+
+    # -- interprocedural reachability -------------------------------------
+
+    def _reachable(self, fn: FunctionInfo, depth: int) -> List[str]:
+        """Blocking descriptions reachable from ``fn`` (itself included),
+        as ``"callee.qualname: desc"`` strings."""
+        key = (id(fn.node), depth)
+        if key in self._reach_memo:
+            return self._reach_memo[key]
+        self._reach_memo[key] = []  # cycle guard
+        out = [
+            f"{fn.qualname}:{s.line} {s.desc}"
+            for s in self._direct_sites(fn)
+            # A callee waiting on its OWN held condition is that
+            # callee's business (it releases the lock it holds); it
+            # does not release OUR caller-held lock, so it still
+            # counts — no exemption here.
+        ]
+        if depth > 0:
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    callee = self.index.resolve_call(fn, node)
+                    if callee is not None and id(callee.node) != id(fn.node):
+                        out.extend(self._reachable(callee, depth - 1))
+        out = out[:8]  # witness list, not an enumeration
+        self._reach_memo[key] = out
+        return out
+
+    # -- with-body walk ----------------------------------------------------
+
+    def _scan(self, fn: FunctionInfo, node: ast.AST,
+              held: List[Tuple[str, str]]) -> None:
+        """``held``: (lock name, kind) stack of with-acquired locks."""
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = 0
+            for item in node.items:
+                name = self.index.resolve_lock_expr(fn, item.context_expr)
+                if name is not None:
+                    kind = self.index.lock_kinds.get(name, "lock")
+                    held.append((name, kind))
+                    acquired += 1
+            for stmt in node.body:
+                self._scan(fn, stmt, held)
+            for _ in range(acquired):
+                held.pop()
+            return
+        if isinstance(node, ast.Call) and held:
+            self._check_call(fn, node, held)
+        for child in ast.iter_child_nodes(node):
+            self._scan(fn, child, held)
+
+    def _check_call(self, fn: FunctionInfo, call: ast.Call,
+                    held: List[Tuple[str, str]]) -> None:
+        site = self._classify(call)
+        held_names = [h[0] for h in held]
+        if site is not None:
+            if site.recv is not None:
+                recv_lock = self.index.resolve_lock_expr(fn, site.recv)
+                if recv_lock is not None and recv_lock in held_names:
+                    # cond.wait on the held condition releases it — but
+                    # ONLY that condition; any other lock stays held
+                    # across the park and still convoys its waiters.
+                    others = [h for h in held_names if h != recv_lock]
+                    if not others:
+                        return
+                    held_names = others
+            self.report(
+                fn.module, call,
+                f"{site.desc} while holding {held_names[-1]!r} "
+                f"(in {fn.qualname}); a peer needing the lock convoys "
+                "behind an unbounded wait — bound it or move it outside "
+                "the lock",
+            )
+            return
+        callee = self.index.resolve_call(fn, call)
+        if callee is not None:
+            reached = self._reachable(
+                callee, self.config.blocking_depth - 1
+            )
+            if reached:
+                self.report(
+                    fn.module, call,
+                    f"call to {callee.qualname} while holding "
+                    f"{held_names[-1]!r} (in {fn.qualname}) reaches a "
+                    f"blocking primitive: {reached[0]}",
+                )

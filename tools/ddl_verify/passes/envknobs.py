@@ -1,0 +1,311 @@
+"""VP003 — the machine-checked env-knob contract.
+
+Four claims, checked statically against a parse of
+``ddl_tpu/envspec.py`` (the registry) and ``ddl_tpu/config.py`` (the
+dataclass-derived ``DDL_TPU_<FIELD>`` / ``DDL_TPU_TRAIN_<FIELD>``
+families):
+
+1. **No undeclared knob.**  Every ``DDL_TPU_*`` name passed to an
+   envspec accessor (``raw``/``get``/``flag``/``require``) or to
+   ``env_flag`` is registered.
+2. **No bypass.**  No ``os.environ.get``/``os.getenv``/subscript-read
+   of a ``DDL_TPU_*`` name outside the registry module itself — reads
+   resolve through the typed accessors, which fail loudly on an
+   unregistered name.
+3. **Export mirrors cover their group.**  Every registered knob
+   carrying ``export="<g>"`` appears by name in the matching
+   ``_export_<g>_knobs`` spawn-boundary function (the PR-9 stale-export
+   bug class).  Writes (``os.environ[...] = ``, ``.pop``) and
+   membership tests are the export seams — allowed, but only on
+   registered names.
+4. **No dead registration.**  A registered literal knob (not
+   ``external=True``, not a config-derived family member) whose name
+   never appears in the tree is cruft — delete it or read it.
+
+Name resolution covers string literals and module-level constants
+(``TRACE_ENV = "DDL_TPU_TRACE"``); dynamic names (f-strings, computed
+prefixes) are skipped except for the config families, which are
+derived from the dataclass fields themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.ddl_verify.passes.base import Pass, register
+from tools.ddl_verify.project import ModuleInfo, last_segment
+
+PREFIX = "DDL_TPU_"
+
+_ACCESSORS = {"raw", "get", "flag", "require"}
+
+
+def parse_registry(
+    index, envspec_path: str, config_path: str
+) -> Tuple[Set[str], Dict[str, Set[str]], Set[str], Set[str]]:
+    """``(registered, export_groups, external, derived)`` from a static
+    parse of the registry + config modules (no imports: the analyzer
+    must run on a tree too broken to import)."""
+    registered: Set[str] = set()
+    groups: Dict[str, Set[str]] = {}
+    external: Set[str] = set()
+    derived: Set[str] = set()
+    mod = index.module_by_path(envspec_path)
+    if mod is not None:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and last_segment(node.func) in ("_K", "Knob")
+            ):
+                continue
+            args = list(node.args)
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            name_node = kwargs.get("name") or (args[0] if args else None)
+            if not (
+                isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)
+            ):
+                continue
+            name = name_node.value
+            registered.add(name)
+            exp = kwargs.get("export")
+            if isinstance(exp, ast.Constant) and isinstance(exp.value, str):
+                groups.setdefault(exp.value, set()).add(name)
+            ext = kwargs.get("external")
+            if isinstance(ext, ast.Constant) and ext.value is True:
+                external.add(name)
+    cfg_mod = index.module_by_path(config_path)
+    if cfg_mod is not None:
+        for node in cfg_mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            prefix = None
+            fields: List[str] = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if (
+                            isinstance(tgt, ast.Name)
+                            and tgt.id == "_ENV_PREFIX"
+                            and isinstance(stmt.value, ast.Constant)
+                        ):
+                            prefix = stmt.value.value
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    if not stmt.target.id.startswith("_"):
+                        fields.append(stmt.target.id)
+            if prefix:
+                for f in fields:
+                    name = prefix + f.upper()
+                    registered.add(name)
+                    derived.add(name)
+    return registered, groups, external, derived
+
+
+def _is_environ(expr: ast.AST) -> bool:
+    """``os.environ`` (or a bare ``environ`` import)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr == "environ"
+    return isinstance(expr, ast.Name) and expr.id == "environ"
+
+
+@register
+class EnvKnobContract(Pass):
+    code = "VP003"
+    summary = "env knob unregistered / bypassing envspec / export drift"
+
+    def run(self):
+        index = self.index
+        if self.config.registered_knobs:
+            registered = set(self.config.registered_knobs)
+            groups: Dict[str, Set[str]] = {}
+            external: Set[str] = set(registered)  # no dead-knob check
+            derived: Set[str] = set()
+        else:
+            registered, groups, external, derived = parse_registry(
+                index, self.config.envspec_module,
+                self.config.config_module,
+            )
+            if not registered:
+                self.report(
+                    self.config.envspec_module, 1,
+                    f"no knob registry found in "
+                    f"{self.config.envspec_module} (and no "
+                    "registered_knobs override): the env contract is "
+                    "unverifiable",
+                )
+                return self.findings
+        self._registered = registered
+        mentioned: Set[str] = set()
+        export_bodies: Dict[str, Tuple[str, int, Set[str]]] = {}
+        for mod in index.modules:
+            if self._is_module(mod, self.config.envspec_module):
+                continue  # registration literals are not "reads"
+            for name in self._all_ddl_literals(mod):
+                mentioned.add(name)
+            self._scan_module(mod)
+            self._collect_exports(mod, export_bodies)
+        # 3. export-group coverage
+        for group, members in groups.items():
+            fn_name = f"_export_{group}_knobs"
+            body = export_bodies.get(fn_name)
+            if body is None:
+                # No mirror function: only a finding when the group has
+                # members (the registry says they cross the boundary).
+                if members:
+                    self.report(
+                        self.config.envspec_module, 1,
+                        f"registry group export={group!r} has no "
+                        f"{fn_name} spawn-boundary mirror",
+                    )
+                continue
+            path, line, names = body
+            missing = sorted(members - names)
+            if missing:
+                self.report(
+                    path, line,
+                    f"{fn_name} does not mirror registered group "
+                    f"members: {', '.join(missing)} (spawned workers "
+                    "would silently miss them)",
+                )
+        # 4. dead registrations
+        for name in sorted(registered - mentioned):
+            if name in external or name in derived:
+                continue
+            self.report(
+                self.config.envspec_module, 1,
+                f"{name} is registered but never read anywhere in the "
+                "tree; delete the entry or mark it external=True with "
+                "a doc pointing at the out-of-tree reader",
+            )
+        return self.findings
+
+    def _is_module(self, mod: ModuleInfo, suffix: str) -> bool:
+        p = mod.path.replace("\\", "/")
+        return p == suffix or p.endswith("/" + suffix)
+
+    def _all_ddl_literals(self, mod: ModuleInfo):
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith(PREFIX)
+            ):
+                yield node.value
+
+    def _resolve(self, mod: ModuleInfo, expr: ast.AST) -> Optional[str]:
+        return self.index.resolve_constant(mod.path, expr)
+
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                self._scan_call(mod, node)
+            elif isinstance(node, ast.Subscript) and _is_environ(
+                node.value
+            ):
+                name = self._resolve(mod, node.slice)
+                if name is None or not name.startswith(PREFIX):
+                    continue
+                if isinstance(node.ctx, ast.Load):
+                    self.report(
+                        mod.path, node,
+                        f"os.environ[{name!r}] read bypasses the "
+                        "envspec registry; use envspec.raw/get/flag",
+                    )
+                elif name not in self._registered:
+                    self.report(
+                        mod.path, node,
+                        f"os.environ write to unregistered knob "
+                        f"{name!r}; register it in envspec.py",
+                    )
+            elif isinstance(node, ast.Compare):
+                # `"DDL_TPU_X" in os.environ` membership (export seams).
+                if len(node.ops) == 1 and isinstance(
+                    node.ops[0], (ast.In, ast.NotIn)
+                ) and _is_environ(node.comparators[0]):
+                    name = self._resolve(mod, node.left)
+                    if (
+                        name is not None
+                        and name.startswith(PREFIX)
+                        and name not in self._registered
+                    ):
+                        self.report(
+                            mod.path, node,
+                            f"membership test on unregistered knob "
+                            f"{name!r}; register it in envspec.py",
+                        )
+
+    def _scan_call(self, mod: ModuleInfo, call: ast.Call) -> None:
+        func = call.func
+        seg = last_segment(func)
+        # envspec.<accessor>(NAME) / env_flag(NAME)
+        is_accessor = (
+            seg in _ACCESSORS
+            and isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "envspec"
+        )
+        if is_accessor or seg == "env_flag":
+            if call.args:
+                name = self._resolve(mod, call.args[0])
+                if (
+                    name is not None
+                    and name.startswith(PREFIX)
+                    and name not in self._registered
+                ):
+                    self.report(
+                        mod.path, call,
+                        f"env knob {name!r} is read but not registered "
+                        "in envspec.py; declare name/type/default/doc",
+                    )
+            return
+        # os.environ.get(NAME) / os.getenv(NAME) / os.environ.pop(NAME)
+        if not isinstance(func, ast.Attribute):
+            return
+        reads = (
+            (func.attr == "get" and _is_environ(func.value))
+            or (
+                func.attr == "getenv"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "os"
+            )
+        )
+        pops = func.attr == "pop" and _is_environ(func.value)
+        if not (reads or pops) or not call.args:
+            return
+        name = self._resolve(mod, call.args[0])
+        if name is None or not name.startswith(PREFIX):
+            return
+        if reads:
+            self.report(
+                mod.path, call,
+                f"raw environ read of {name!r} bypasses the envspec "
+                "registry; use envspec.raw/get/flag",
+            )
+        elif name not in self._registered:
+            self.report(
+                mod.path, call,
+                f"os.environ.pop of unregistered knob {name!r}; "
+                "register it in envspec.py",
+            )
+
+    def _collect_exports(
+        self, mod: ModuleInfo,
+        out: Dict[str, Tuple[str, int, Set[str]]],
+    ) -> None:
+        for node in mod.tree.body:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name.startswith("_export_")
+                and node.name.endswith("_knobs")
+            ):
+                names = {
+                    n.value
+                    for n in ast.walk(node)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                    and n.value.startswith(PREFIX)
+                }
+                out[node.name] = (mod.path, node.lineno, names)

@@ -1,0 +1,256 @@
+"""VP001 — whole-program lock-order graph.
+
+Builds the directed acquisition graph over the named-lock identities:
+an edge ``A -> B`` means some execution path acquires ``B`` while
+holding ``A`` — lexically nested ``with`` blocks in one function, or a
+call chain from inside a ``with`` body to a function that (transitively)
+acquires ``B``.  Three checks:
+
+1. every constructed lock name appears in the declared ``LOCK_ORDER``
+   (an unranked lock is invisible to the whole contract),
+2. no edge runs backwards against the declared order (the inversion
+   only needs a second thread running the compliant order to deadlock),
+3. the graph is acyclic (a cycle is a potential deadlock even if the
+   declared order missed it).
+
+Self-edges (``A -> A``) are skipped: sibling instances share a name,
+and the re-entrant primitives legitimately re-acquire — a same-name
+claim would be noise, not signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.ddl_verify.passes.base import Pass, register
+from tools.ddl_verify.project import FunctionInfo, ProjectIndex
+
+
+def parse_lock_order(index: ProjectIndex, module_path: str) -> List[str]:
+    """The ``LOCK_ORDER`` tuple literal from the concurrency module."""
+    mod = index.module_by_path(module_path)
+    if mod is None:
+        return []
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "LOCK_ORDER":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    return [
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+    return []
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "module", "line", "via")
+
+    def __init__(self, src: str, dst: str, module: str, line: int,
+                 via: str):
+        self.src, self.dst = src, dst
+        self.module, self.line, self.via = module, line, via
+
+
+@register
+class LockOrderGraph(Pass):
+    code = "VP001"
+    summary = "cross-module lock-order inversion / deadlock cycle"
+
+    def run(self):
+        index = self.index
+        order = list(self.config.lock_order) or parse_lock_order(
+            index, self.config.concurrency_module
+        )
+        if not order and index.lock_kinds:
+            # Locks exist but no declared order — the contract itself is
+            # missing; every other claim would be vacuous.
+            first = index.lock_sites[0]
+            self.report(
+                first[1], first[2],
+                f"named locks exist but no LOCK_ORDER found in "
+                f"{self.config.concurrency_module} (and no lock_order "
+                "config override): declare the hierarchy",
+            )
+            return self.findings
+        rank = {name: i for i, name in enumerate(order)}
+        for name, module, line in index.lock_sites:
+            if name not in rank:
+                self.report(
+                    module, line,
+                    f"lock {name!r} is constructed but missing from "
+                    "LOCK_ORDER; add it at its hierarchy position",
+                )
+        edges = self._collect_edges()
+        seen_pairs: Set[Tuple[str, str]] = set()
+        graph: Dict[str, Set[str]] = {}
+        witness: Dict[Tuple[str, str], _Edge] = {}
+        for e in edges:
+            if e.src == e.dst:
+                continue
+            pair = (e.src, e.dst)
+            if pair not in seen_pairs:
+                seen_pairs.add(pair)
+                witness[pair] = e
+                graph.setdefault(e.src, set()).add(e.dst)
+                r_src, r_dst = rank.get(e.src), rank.get(e.dst)
+                if r_src is not None and r_dst is not None and r_src > r_dst:
+                    self.report(
+                        e.module, e.line,
+                        f"acquires {e.dst!r} while holding {e.src!r} "
+                        f"({e.via}) — inverts LOCK_ORDER "
+                        f"({e.dst!r} ranks before {e.src!r})",
+                    )
+        for cycle in self._cycles(graph):
+            pair = (cycle[0], cycle[1 % len(cycle)])
+            w = witness.get(pair)
+            loc = (w.module, w.line) if w else ("<graph>", 1)
+            self.report(
+                loc[0], loc[1],
+                "lock-acquisition cycle (potential deadlock): "
+                + " -> ".join(cycle + [cycle[0]]),
+            )
+        return self.findings
+
+    # -- graph construction ------------------------------------------------
+
+    def _collect_edges(self) -> List[_Edge]:
+        edges: List[_Edge] = []
+        self._locks_memo: Dict[int, Set[str]] = {}
+        self._locks_inflight: Set[int] = set()
+        for infos in self.index.functions.values():
+            for fn in infos:
+                for stmt in fn.node.body:
+                    self._scan(fn, stmt, [], edges)
+        return edges
+
+    def _scan(self, fn: FunctionInfo, node: ast.AST, held: List[str],
+              edges: List[_Edge]) -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                name = self.index.resolve_lock_expr(fn, item.context_expr)
+                if name is not None:
+                    for h in held:
+                        edges.append(_Edge(
+                            h, name, fn.module, node.lineno,
+                            f"lexically nested in {fn.qualname}",
+                        ))
+                    held.append(name)
+                    acquired.append(name)
+            for stmt in node.body:
+                self._scan(fn, stmt, held, edges)
+            for _ in acquired:
+                held.pop()
+            return
+        if isinstance(node, ast.Call) and held:
+            # `other.acquire(...)` on a resolvable lock is an edge too.
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                name = self.index.resolve_lock_expr(fn, node.func.value)
+                if name is not None:
+                    for h in held:
+                        edges.append(_Edge(
+                            h, name, fn.module, node.lineno,
+                            f"direct acquire in {fn.qualname}",
+                        ))
+            callee = self.index.resolve_call(fn, node)
+            if callee is not None:
+                for lock in self._transitive_locks(callee):
+                    for h in held:
+                        edges.append(_Edge(
+                            h, lock, fn.module, node.lineno,
+                            f"via call {fn.qualname} -> "
+                            f"{callee.qualname}",
+                        ))
+        for child in ast.iter_child_nodes(node):
+            self._scan(fn, child, held, edges)
+
+    def _transitive_locks(self, fn: FunctionInfo) -> Set[str]:
+        """Every lock ``fn`` may acquire, directly or via resolvable
+        calls (memoized fixpoint; in-flight recursion contributes
+        nothing extra)."""
+        key = id(fn.node)
+        if key in self._locks_memo:
+            return self._locks_memo[key]
+        if key in self._locks_inflight:
+            return set()
+        self._locks_inflight.add(key)
+        out: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    name = self.index.resolve_lock_expr(
+                        fn, item.context_expr
+                    )
+                    if name is not None:
+                        out.add(name)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    name = self.index.resolve_lock_expr(
+                        fn, node.func.value
+                    )
+                    if name is not None:
+                        out.add(name)
+                callee = self.index.resolve_call(fn, node)
+                if callee is not None and id(callee.node) != key:
+                    out |= self._transitive_locks(callee)
+        self._locks_inflight.discard(key)
+        self._locks_memo[key] = out
+        return out
+
+    # -- cycle detection ---------------------------------------------------
+
+    @staticmethod
+    def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+        """Strongly connected components of size > 1 (Tarjan)."""
+        idx: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        out: List[List[str]] = []
+
+        def strongconnect(v: str) -> None:
+            idx[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in graph.get(v, ()):
+                if w not in idx:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], idx[w])
+            if low[v] == idx[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in idx:
+                strongconnect(v)
+        return out

@@ -1,0 +1,124 @@
+"""VP004 — cross-process control-protocol exhaustiveness.
+
+``ddl_tpu/types.py`` declares the control-channel protocol as data:
+``CONSUMER_TO_PRODUCER_CONTROL`` / ``PRODUCER_TO_CONSUMER_CONTROL``
+tuples of message classes.  For each direction's configured dispatcher
+(``DataPusher._poll_control``, the consumer obs drain), the pass checks
+both directions of the contract:
+
+- every declared type has an ``isinstance`` arm in every dispatcher for
+  its direction (a new message class cannot ship that one side silently
+  drops as "unexpected"), and
+- every ``isinstance`` arm matching a types-module class names a
+  declared type for that direction (a dispatch arm cannot ship without
+  declaring the message in the protocol).
+
+``str`` sentinels (the ABORT broadcast) and non-protocol classes are
+outside the tuples by design and ignored here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.ddl_verify.passes.base import Pass, register
+from tools.ddl_verify.project import walk_no_defs
+
+_TUPLES = {
+    "CONSUMER_TO_PRODUCER_CONTROL": "consumer_to_producer_dispatchers",
+    "PRODUCER_TO_CONSUMER_CONTROL": "producer_to_consumer_dispatchers",
+}
+
+
+@register
+class ProtocolExhaustiveness(Pass):
+    code = "VP004"
+    summary = "control-channel message type without a dispatch arm"
+
+    def run(self):
+        index = self.index
+        types_mod = index.module_by_path(self.config.types_module)
+        if types_mod is None:
+            self.report(
+                self.config.types_module, 1,
+                f"types module {self.config.types_module} not found; "
+                "the protocol contract is unverifiable",
+            )
+            return self.findings
+        declared: Dict[str, List[str]] = {}
+        type_classes: Set[str] = {
+            n.name
+            for n in types_mod.tree.body
+            if isinstance(n, ast.ClassDef)
+        }
+        for node in types_mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in _TUPLES:
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        declared[tgt.id] = [
+                            e.id
+                            for e in node.value.elts
+                            if isinstance(e, ast.Name)
+                        ]
+        for tuple_name, cfg_attr in _TUPLES.items():
+            if tuple_name not in declared:
+                self.report(
+                    types_mod.path, 1,
+                    f"{tuple_name} protocol declaration missing from "
+                    f"{self.config.types_module}",
+                )
+                continue
+            types = declared[tuple_name]
+            for qual in getattr(self.config, cfg_attr):
+                fn = index.find_function(qual)
+                if fn is None:
+                    self.report(
+                        types_mod.path, 1,
+                        f"configured dispatcher {qual} for {tuple_name} "
+                        "not found in the tree",
+                    )
+                    continue
+                seen = self._isinstance_arms(fn.node)
+                for t in types:
+                    if t not in seen:
+                        self.report(
+                            fn.module, fn.node,
+                            f"{qual} has no isinstance arm for declared "
+                            f"control type {t} ({tuple_name}); the "
+                            "message would be dropped as unexpected",
+                        )
+                for t in sorted(seen & type_classes):
+                    if t not in types:
+                        self.report(
+                            fn.module, fn.node,
+                            f"{qual} dispatches on {t}, which is not "
+                            f"declared in {tuple_name}; add it to the "
+                            "protocol tuple in types.py",
+                        )
+        return self.findings
+
+    @staticmethod
+    def _isinstance_arms(fn_node: ast.AST) -> Set[str]:
+        seen: Set[str] = set()
+        for node in walk_no_defs(fn_node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "isinstance"
+                and len(node.args) == 2
+            ):
+                second = node.args[1]
+                elts = (
+                    second.elts
+                    if isinstance(second, (ast.Tuple, ast.List))
+                    else [second]
+                )
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        seen.add(e.id)
+                    elif isinstance(e, ast.Attribute):
+                        seen.add(e.attr)
+        return seen

@@ -1,0 +1,291 @@
+"""The whole-program index: functions, named locks, calls, constants.
+
+One parse of every module feeds every pass.  Resolution is deliberately
+conservative — an edge or a lock identity is only recorded when the AST
+supports exactly one reading (same-class method, same-module function,
+or a project-wide unique name).  A pass that cannot resolve a call
+skips it: ddl-verify's findings must be worth fixing, so precision wins
+over recall at every ambiguity.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: The concurrency-module factory names mapped to the primitive kind.
+LOCK_FACTORIES = {
+    "named_lock": "lock",
+    "named_rlock": "rlock",
+    "named_condition": "condition",
+}
+
+#: Method names too generic to resolve by project-wide uniqueness —
+#: stdlib/container vocabulary that would otherwise alias unrelated
+#: classes together.
+_NEVER_RESOLVE = {
+    "get", "put", "pop", "append", "extend", "add", "remove", "discard",
+    "update", "items", "keys", "values", "join", "split", "close",
+    "read", "write", "open", "send", "recv", "copy", "clear", "start",
+    "stop", "run", "next", "__next__", "wait", "acquire", "release",
+    "notify", "notify_all", "sleep", "result", "cancel", "set",
+}
+
+
+def last_segment(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def walk_no_defs(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk a subtree without descending into nested defs/classes."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One module-level function or method."""
+
+    name: str               # bare name
+    qualname: str           # "Class.method" or bare name
+    cls: Optional[str]      # enclosing class, if a method
+    module: str             # repo-relative path
+    node: ast.AST           # the FunctionDef
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str               # repo-relative, '/'-separated
+    source: str
+    tree: ast.Module
+
+
+class ProjectIndex:
+    """Cross-module facts shared by every pass."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = list(modules)
+        #: qualname -> every definition (same qualname may repeat).
+        self.functions: Dict[str, List[FunctionInfo]] = {}
+        #: (class, method) -> definitions.
+        self.methods: Dict[Tuple[str, str], List[FunctionInfo]] = {}
+        #: bare method name -> definitions across every class.
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        #: (module, name) -> module-level function.
+        self.module_funcs: Dict[Tuple[str, str], FunctionInfo] = {}
+        #: bare name -> module-level functions across the project.
+        self.module_funcs_by_name: Dict[str, List[FunctionInfo]] = {}
+        #: (class, attr) -> lock names assigned via named_* factories.
+        self.attr_locks: Dict[Tuple[str, str], Set[str]] = {}
+        #: attr -> lock names across every class (fallback resolution).
+        self.attr_locks_by_attr: Dict[str, Set[str]] = {}
+        #: (module, var) -> lock name for module-level locks.
+        self.global_locks: Dict[Tuple[str, str], str] = {}
+        #: var -> lock names across modules (import-aliased fallback).
+        self.global_locks_by_name: Dict[str, Set[str]] = {}
+        #: lock name -> primitive kind ("lock"/"rlock"/"condition").
+        self.lock_kinds: Dict[str, str] = {}
+        #: every (lockname, module, line) construction site.
+        self.lock_sites: List[Tuple[str, str, int]] = []
+        #: (module, NAME) -> module-level string-constant value.
+        self.constants: Dict[Tuple[str, str], str] = {}
+        for mod in self.modules:
+            self._index_module(mod)
+
+    # -- construction ------------------------------------------------------
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                self._index_assign(mod, node, cls=None)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mod, node, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._add_function(mod, sub, cls=node.name)
+                        for inner in ast.walk(sub):
+                            if isinstance(inner, ast.Assign):
+                                self._index_assign(
+                                    mod, inner, cls=node.name
+                                )
+
+    def _add_function(
+        self, mod: ModuleInfo, node: ast.AST, cls: Optional[str]
+    ) -> None:
+        name = node.name
+        qual = f"{cls}.{name}" if cls else name
+        info = FunctionInfo(
+            name=name, qualname=qual, cls=cls, module=mod.path, node=node
+        )
+        self.functions.setdefault(qual, []).append(info)
+        if cls:
+            self.methods.setdefault((cls, name), []).append(info)
+            self.methods_by_name.setdefault(name, []).append(info)
+        else:
+            self.module_funcs[(mod.path, name)] = info
+            self.module_funcs_by_name.setdefault(name, []).append(info)
+
+    def _lock_call(self, value: ast.AST) -> Optional[Tuple[str, str]]:
+        """``(lock_name, kind)`` if ``value`` is a named_* factory call."""
+        if not isinstance(value, ast.Call):
+            return None
+        fname = last_segment(value.func)
+        kind = LOCK_FACTORIES.get(fname or "")
+        if kind is None or not value.args:
+            return None
+        arg = value.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value, kind
+        return None
+
+    def _index_assign(
+        self, mod: ModuleInfo, node: ast.Assign, cls: Optional[str]
+    ) -> None:
+        hit = self._lock_call(node.value)
+        if hit is None:
+            # Module-level string constants (TRACE_ENV = "DDL_TPU_TRACE")
+            # feed name resolution in VP003.
+            if (
+                cls is None
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.constants[(mod.path, tgt.id)] = node.value.value
+            return
+        lock_name, kind = hit
+        self.lock_kinds[lock_name] = kind
+        self.lock_sites.append((lock_name, mod.path, node.lineno))
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and cls is None:
+                self.global_locks[(mod.path, tgt.id)] = lock_name
+                self.global_locks_by_name.setdefault(tgt.id, set()).add(
+                    lock_name
+                )
+            elif (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and cls is not None
+            ):
+                self.attr_locks.setdefault((cls, tgt.attr), set()).add(
+                    lock_name
+                )
+                self.attr_locks_by_attr.setdefault(tgt.attr, set()).add(
+                    lock_name
+                )
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_constant(self, module: str, expr: ast.AST) -> Optional[str]:
+        """A string literal or module-level string constant, else None."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            return self.constants.get((module, expr.id))
+        # MODULE.CONST cross-module reference: unique constant name wins.
+        if isinstance(expr, ast.Attribute):
+            hits = {
+                v for (m, n), v in self.constants.items()
+                if n == expr.attr
+            }
+            if len(hits) == 1:
+                return next(iter(hits))
+        return None
+
+    def resolve_lock_expr(
+        self, fn: FunctionInfo, expr: ast.AST
+    ) -> Optional[str]:
+        """The lock name a ``with <expr>:`` acquires, if resolvable."""
+        if isinstance(expr, ast.Call):
+            # `with named_lock("x")` inline, or acquire_timeout wrappers:
+            hit = self._lock_call(expr)
+            if hit is not None:
+                return hit[0]
+            return None
+        if isinstance(expr, ast.Name):
+            local = self.global_locks.get((fn.module, expr.id))
+            if local is not None:
+                return local
+            # Imported module-level lock: unique var name project-wide.
+            names = self.global_locks_by_name.get(expr.id)
+            if names is not None and len(names) == 1:
+                return next(iter(names))
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if fn.cls is not None:
+                    names = self.attr_locks.get((fn.cls, attr))
+                    if names is not None and len(names) == 1:
+                        return next(iter(names))
+                    if names:
+                        return None  # ambiguous within the class
+            # Non-self receiver (or miss): unique attr name project-wide.
+            names = self.attr_locks_by_attr.get(attr)
+            if names is not None and len(names) == 1:
+                return next(iter(names))
+        return None
+
+    def resolve_call(
+        self, fn: FunctionInfo, call: ast.Call
+    ) -> Optional[FunctionInfo]:
+        """The single definition a call can mean, or None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            local = self.module_funcs.get((fn.module, name))
+            if local is not None:
+                return local
+            cands = self.module_funcs_by_name.get(name, [])
+            if len(cands) == 1:
+                return cands[0]
+            return None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            if name in _NEVER_RESOLVE:
+                return None
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                if fn.cls is not None:
+                    cands = self.methods.get((fn.cls, name), [])
+                    if len(cands) == 1:
+                        return cands[0]
+                    if cands:
+                        return None
+            cands = self.methods_by_name.get(name, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def find_function(self, qualname: str) -> Optional[FunctionInfo]:
+        cands = self.functions.get(qualname, [])
+        return cands[0] if cands else None
+
+    def module_by_path(self, suffix: str) -> Optional[ModuleInfo]:
+        """The module whose repo-relative path matches ``suffix``."""
+        for mod in self.modules:
+            p = mod.path.replace("\\", "/")
+            if p == suffix or p.endswith("/" + suffix):
+                return mod
+        return None
+
+
+def build_index(modules: Sequence[ModuleInfo]) -> ProjectIndex:
+    return ProjectIndex(list(modules))

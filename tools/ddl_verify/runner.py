@@ -1,0 +1,94 @@
+"""Parse the whole tree once, build the :class:`ProjectIndex`, run every
+enabled pass, filter findings through ``# ddl-verify: disable=`` pragmas
+and per-path config ignores.
+
+Unlike ddl-lint (one module at a time), a verify pass may attribute a
+finding to any file in the index — the suppression tables are therefore
+collected for *every* parsed file up front and looked up by the
+finding's path.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.ddl_lint.config import find_pyproject
+from tools.ddl_lint.findings import Finding
+from tools.ddl_lint.runner import _rel_path, discover_files
+from tools.ddl_lint.suppress import collect_suppressions, is_suppressed
+from tools.ddl_verify.config import VerifyConfig, load_config
+from tools.ddl_verify.passes import PASS_REGISTRY
+from tools.ddl_verify.project import ModuleInfo, build_index
+
+_TAG = "ddl-verify:"
+
+
+def run_paths(
+    paths: Sequence[str],
+    config: Optional[VerifyConfig] = None,
+    config_file: Optional[str] = None,
+) -> List[Finding]:
+    """Verify ``paths`` and return sorted findings.
+
+    ``config=None`` loads ``[tool.ddl_verify]`` from the nearest
+    pyproject.toml above the first path (or cwd); the test fixtures pass
+    an explicit :class:`VerifyConfig` so repo policy cannot mask a
+    regressed pass.
+    """
+    files = discover_files(paths)
+    root: Optional[Path] = None
+    if config is None:
+        if config_file:
+            pyproject = Path(config_file)
+            # Fail-loud, same rule as ddl-lint: a typo'd --config would
+            # silently swap repo policy for built-in defaults.
+            if not pyproject.is_file():
+                raise FileNotFoundError(
+                    f"config file does not exist: {config_file}"
+                )
+        else:
+            pyproject = find_pyproject(
+                Path(paths[0]) if paths else Path.cwd()
+            )
+        config = load_config(pyproject)
+        if pyproject is not None:
+            root = pyproject.parent.resolve()
+    parse_failures: List[Finding] = []
+    modules: List[ModuleInfo] = []
+    suppressions: Dict[str, Tuple[dict, set]] = {}
+    for f in files:
+        rel = _rel_path(f, root)
+        try:
+            source = f.read_text(encoding="utf-8")
+            tree = ast.parse(source)
+        except (OSError, SyntaxError, ValueError) as e:
+            parse_failures.append(
+                Finding(
+                    path=rel,
+                    line=getattr(e, "lineno", 1) or 1,
+                    col=1,
+                    code="VP000",
+                    message=f"cannot analyze: {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        modules.append(ModuleInfo(path=rel, source=source, tree=tree))
+        suppressions[rel] = collect_suppressions(source, tag=_TAG)
+    index = build_index(modules)
+    findings: List[Finding] = list(parse_failures)
+    for code in config.enabled_passes():
+        if code not in PASS_REGISTRY:
+            continue
+        for finding in PASS_REGISTRY[code](index, config).run():
+            if finding.code in config.ignored_for(finding.path):
+                continue
+            per_line, file_wide = suppressions.get(
+                finding.path, ({}, set())
+            )
+            if not is_suppressed(
+                finding.code, finding.line, per_line, file_wide
+            ):
+                findings.append(finding)
+    return sorted(findings)
