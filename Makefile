@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test
+.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -136,6 +136,29 @@ preempt-test:
 # bound — byte-identical resume asserted in the artifact.
 preempt-bench:
 	DDL_BENCH_MODE=preempt JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Host-vs-device global-shuffle exchange A/B (ThreadExchangeShuffler
+# over the rendezvous boards vs the on-mesh DeviceExchangeShuffler;
+# docs/PERF_NOTES.md "Device-side global shuffle").  Byte identity of
+# the post-exchange pools asserted per rep; winner is the headline.
+# On a TPU pod the ring kernel runs real DMAs; elsewhere interpret
+# mode on the virtual mesh (the host path usually wins there — the
+# contract, not the speedup, is what CI gates on).
+shuffle-bench:
+	DDL_BENCH_MODE=shuffle JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Analytic exchange pricing (device ICI bytes vs host boards raw/wire
+# per plan_exchange) across ring widths + a live byte-identity parity
+# run for both impls on the virtual mesh — the mirror of
+# probe_ici/probe_wire for the shuffle tier.
+shuffle-dryrun:
+	JAX_PLATFORMS=cpu $(PY) tools/probe_shuffle.py
+
+# Device-exchange suite alone (seed parity across geometries, the DMA
+# -failure/peer-loss chaos rungs, resolution surface, end-to-end
+# stream identity in THREAD and PROCESS modes).
+shuffle-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_device_shuffle.py -q
 
 # Tracing-layer suite alone (Metrics histograms, SpanLog/Chrome export,
 # cross-process aggregation, flight recorder, the doc-reflection test;

@@ -2804,6 +2804,202 @@ def _run_ici_ab(platform: str) -> dict:
     return _gate_utilization(block, "ici per-hop")
 
 
+# -- device-shuffle A/B --------------------------------------------------------
+
+
+def _run_shuffle_ab(platform: str) -> dict:
+    """The global-shuffle exchange A/B (ROADMAP item 2 / ISSUE 17): the
+    same seeded epoch exchange run two ways — ``host``
+    (``ThreadExchangeShuffler`` over the in-process rendezvous, the
+    2n-mailbox-hop path) vs ``device`` (``DeviceExchangeShuffler``: one
+    collective over the ring per round, ``ddl_tpu/ops/device_shuffle``)
+    — measured INTERLEAVED, best-of both sides, byte-identity of the
+    post-exchange pools asserted per rep.
+
+    The headline is the WINNER's bytes/s (the never-headline-slower
+    invariant every competition rides).  Off-TPU the ring kernel runs
+    in interpret mode on the virtual mesh, where the Python-level
+    emulation usually LOSES to the host memcpy path — the contract
+    (identity, plan accounting, zero fallbacks) must stay green anyway,
+    the ici-bench precedent; the chip A/B is chip_checklist step 11.
+
+    Per-leg wire-byte accounting comes from ``plan_exchange``: the
+    device path's ICI bytes vs what the HOST path would put on the
+    boards raw and wire-encoded (the PR-13 int8 wire pricing, composed
+    via ``DDL_TPU_WIRE_DTYPE``/``DDL_BENCH_SHUFFLE_WIRE``).
+
+    Geometry knobs: ``DDL_BENCH_SHUFFLE_INSTANCES`` (ring width,
+    default min(4, devices)), ``DDL_BENCH_SHUFFLE_ROWS`` (pool rows per
+    instance, default 512 interpreted / 8192 on TPU),
+    ``DDL_BENCH_SHUFFLE_ROUNDS`` (default 4), ``DDL_BENCH_SHUFFLE_REPS``
+    (default 3), ``DDL_BENCH_SHUFFLE_IMPL`` (ring | xla).
+    """
+    import threading
+
+    import jax
+
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.ops.device_shuffle import exchange_wire_bytes, plan_exchange
+    from ddl_tpu.shuffle import (
+        DeviceExchangeFabric,
+        DeviceExchangeShuffler,
+        Rendezvous,
+        ThreadExchangeShuffler,
+    )
+    from ddl_tpu.types import Topology
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    interpret = platform != "tpu"
+    n = int(os.environ.get("DDL_BENCH_SHUFFLE_INSTANCES", min(4, n_dev)))
+    if n < 2 or n_dev < n:
+        raise RuntimeError(
+            f"shuffle A/B needs 2 <= instances <= devices, "
+            f"got {n} instances / {n_dev} devices"
+        )
+    rows = int(os.environ.get(
+        "DDL_BENCH_SHUFFLE_ROWS", "512" if interpret else "8192"
+    ))
+    cols = N_VALUES
+    rounds = int(os.environ.get("DDL_BENCH_SHUFFLE_ROUNDS", "4"))
+    reps = int(os.environ.get("DDL_BENCH_SHUFFLE_REPS", "3"))
+    impl = os.environ.get("DDL_BENCH_SHUFFLE_IMPL", "ring")
+    wire = os.environ.get("DDL_BENCH_SHUFFLE_WIRE") or None
+    num_exchange = rows  # the whole pool travels: the worst-case round
+    half = num_exchange // 2
+    seed = 17
+
+    def pools():
+        rng = np.random.default_rng(3)
+        return [
+            rng.random((rows, cols)).astype(np.float32) for _ in range(n)
+        ]
+
+    def run_rounds(make_shuffler, arys):
+        """All n instances exchanging concurrently (the real shape: the
+        k-th producer of every instance), clocked end to end."""
+        shufs = [make_shuffler(i) for i in range(n)]
+        errs = []
+
+        def worker(i):
+            try:
+                for _ in range(rounds):
+                    shufs[i].global_shuffle(arys[i])
+            except Exception as e:  # noqa: BLE001 - joined + re-raised below
+                errs.append(e)
+
+        ts = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(600)
+        dt = time.perf_counter() - t0
+        if errs or any(t.is_alive() for t in ts):
+            raise RuntimeError(f"exchange workers failed: {errs}")
+        return dt, shufs
+
+    def host_shuffler(rdv):
+        return lambda i: ThreadExchangeShuffler(
+            Topology(n_instances=n, instance_idx=i, n_producers=1),
+            1, num_exchange, rendezvous=rdv, seed=seed,
+        )
+
+    fabric = DeviceExchangeFabric(impl=impl)
+    metrics_by_i = {}
+
+    def device_shuffler(rdv):
+        def make(i):
+            sh = DeviceExchangeShuffler(
+                Topology(n_instances=n, instance_idx=i, n_producers=1),
+                1, num_exchange, rendezvous=rdv,
+                fabric=fabric, seed=seed,
+            )
+            sh.metrics = metrics_by_i.setdefault(i, Metrics())
+            return sh
+
+        return make
+
+    # Warmup (ring-program compiles) + THE byte-identity assertion.
+    host_pools, dev_pools = pools(), pools()
+    run_rounds(host_shuffler(Rendezvous()), host_pools)
+    run_rounds(device_shuffler(Rendezvous()), dev_pools)
+    byte_identical = all(
+        np.array_equal(host_pools[i], dev_pools[i]) for i in range(n)
+    )
+    if not byte_identical:
+        raise RuntimeError(
+            "device exchange diverged from the host path — identical "
+            "seeds must produce identical post-exchange pools"
+        )
+
+    # Interleaved best-of timing: each rep clocks both sides once on
+    # fresh pools, so neither side owns the quiet minutes (the PR 6
+    # vs_baseline discipline).
+    host_s, dev_s = [], []
+    for _ in range(reps):
+        host_s.append(run_rounds(host_shuffler(Rendezvous()), pools())[0])
+        dev_s.append(run_rounds(device_shuffler(Rendezvous()), pools())[0])
+
+    # A latched fallback mid-bench means the "device" timings silently
+    # measured the host path — that is not a result (the ici A/B's
+    # dist.faulted precedent).
+    fallbacks = sum(
+        m.counter("shuffle.device_fallbacks") for m in metrics_by_i.values()
+    )
+    if fallbacks:
+        raise RuntimeError(
+            "device shuffler latched the host fallback during the A/B "
+            f"(shuffle.device_fallbacks={fallbacks})"
+        )
+
+    # Exchanged payload per timed run: both lanes, every instance,
+    # every round.
+    per_round = exchange_wire_bytes(n, half, cols, np.dtype(np.float32))
+    nbytes = per_round * rounds
+    host_rate = nbytes / min(host_s)
+    dev_rate = nbytes / min(dev_s)
+    winner = "device" if dev_rate >= host_rate else "host"
+    plan = plan_exchange(
+        n, num_exchange, cols, np.dtype(np.float32),
+        wire_dtype=wire, n_devices=n_dev,
+    )
+    return {
+        "n_instances": n,
+        "n_devices": n_dev,
+        "impl": impl,
+        "interpret": interpret,
+        "pool_rows": rows,
+        "exchange_rows": num_exchange,
+        "rounds": rounds,
+        "exchanged_mib_per_run": round(nbytes / 2**20, 2),
+        # The host-vs-device competition: the block's headline bytes/s
+        # is the WINNER's (never a config this run measured slower).
+        "bytes_per_s": round(max(host_rate, dev_rate), 1),
+        "winner": winner,
+        "device_bytes_per_s": round(dev_rate, 1),
+        "host_bytes_per_s": round(host_rate, 1),
+        "vs_host": round(dev_rate / host_rate, 3),
+        "byte_identical": byte_identical,
+        # Per-leg wire-byte accounting (plan_exchange): what the device
+        # path puts on ICI vs what the host path's boards carry raw and
+        # wire-encoded (the PR-13 pricing composition).
+        "plannable": plan["plannable"],
+        "wire_dtype": plan["wire_dtype"],
+        "legs": plan["legs"],
+        "ici_bytes_per_round": plan["ici_bytes"],
+        "host_bytes_raw_per_round": plan["host_bytes_raw"],
+        "host_bytes_wire_per_round": plan["host_bytes_wire"],
+        "device_rounds": int(sum(
+            m.counter("shuffle.device_rounds")
+            for m in metrics_by_i.values()
+        )),
+        "fallbacks": int(fallbacks),
+    }
+
+
 # -- distributed-optimizer A/B ------------------------------------------------
 
 
@@ -3117,6 +3313,31 @@ def main() -> None:
             result["headline_config"] = result["ici"]["winner"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["ici"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "shuffle":
+        # `make shuffle-bench` / chip_checklist step 11: the global-
+        # shuffle exchange A/B (host rendezvous vs the device-tier
+        # collective, ISSUE 17) with the winner as the headline — the
+        # same never-headline-slower invariant as the ici/opt
+        # competitions, byte-identity asserted per rep, per-leg
+        # wire-byte accounting in the block (bench_smoke enforces).
+        # Off-TPU the ring runs interpret-mode on the 8-device virtual
+        # mesh (it usually LOSES there — the contract stays green) and
+        # the last_tpu_artifact trail marks the fallback.
+        result["metric"] = "shuffle_bytes_per_sec"
+        result["unit"] = "bytes/s"
+        try:
+            if platform != "tpu":
+                _ensure_virtual_mesh(8)
+            result["shuffle"] = _run_shuffle_ab(platform)
+            result["value"] = result["shuffle"]["bytes_per_s"]
+            result["headline_config"] = result["shuffle"]["winner"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["shuffle"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

@@ -57,6 +57,11 @@ LOCK_ORDER: Tuple[str, ...] = (
     # data-plane rings and exchange
     "transport.shm.build",
     "transport.ring.cond",
+    # Device-tier exchange board (DeviceExchangeFabric) ranks above the
+    # host board: the device tier LATCHES to the host exchange, never
+    # the reverse (the fallback re-run happens after the fabric lock is
+    # released, but the rank still documents the one-way layering).
+    "shuffle.device.cond",
     "shuffle.exchange.cond",
     "shuffle.sweep",
     # shard cache tiers

@@ -77,6 +77,17 @@ class LoaderConfig:
     # (ddl_tpu.env._export_wire_knobs).
     wire_dtype: str = ""
     wire_codec: str = ""
+    # Device-tier global shuffle (ddl_tpu.ops.device_shuffle;
+    # docs/PERF_NOTES.md "Device-side global shuffle").
+    # ``device_shuffle``: "auto" = engage the device exchange when
+    # plannable (THREAD topology, raw wire, in-process fabric),
+    # "0"/"off"/"false" = host exchange only.  ``shuffle_impl``:
+    # "ring" = Pallas remote-DMA ring (double-buffered, rides a landing
+    # slot), "xla" = jitted ppermute lanes.  Mirrored into
+    # DDL_TPU_DEVICE_SHUFFLE / DDL_TPU_SHUFFLE_IMPL ahead of producer
+    # spawn (ddl_tpu.env._export_shuffle_knobs).
+    device_shuffle: str = "auto"
+    shuffle_impl: str = "ring"
 
     _ENV_PREFIX = "DDL_TPU_"
 

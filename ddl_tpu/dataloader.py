@@ -213,8 +213,10 @@ class DistributedDataLoader:
         # window may be re-requested before the corruption is declared
         # unrecoverable.  Replay rewinds the producer function, which is
         # only sound without cross-instance exchange (peer-contributed
-        # rows are not locally regenerable) — with shuffle active a
-        # corrupt slot escalates straight to IntegrityError.
+        # rows are not locally regenerable, whichever transport carried
+        # them — host rendezvous or the device tier's ICI exchange) —
+        # with shuffle active a corrupt slot escalates straight to
+        # IntegrityError.
         self._integrity = all(getattr(r, "integrity", False) for r in replies)
         # Wire format per producer (ddl_tpu.wire): slots from a
         # wire-encoded producer carry the bf16/int8 payload + trailer

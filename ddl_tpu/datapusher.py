@@ -291,9 +291,12 @@ class DataPusher:
                         span,
                         "host-side global shuffle cannot span hosts "
                         "(exchange partners are other instances' "
-                        "producer processes); use the device exchange "
-                        "(ddl_tpu.parallel.DeviceGlobalShuffler over "
-                        "the instance mesh axis) for MULTIHOST runs",
+                        "producer processes); use the trainer-side "
+                        "device exchange (ddl_tpu.parallel."
+                        "DeviceGlobalShuffler over the instance mesh "
+                        "axis) for MULTIHOST runs — the producer-side "
+                        "DeviceExchangeShuffler resolves its device "
+                        "tier off outside THREAD topologies",
                     )
                 if connection.cross_process and span == "thread":
                     raise DoesNotMatchError(
@@ -303,7 +306,10 @@ class DataPusher:
                         "own private board until timeout); pass "
                         "ThreadExchangeShuffler.factory(rendezvous="
                         "ShmRendezvous(session)) with a shared session "
-                        "string, or use the device exchange",
+                        "string — DeviceExchangeShuffler.factory "
+                        "accepts the same and runs the host exchange "
+                        "over it across processes — or use the "
+                        "trainer-side device exchange",
                     )
                 self.callbacks.append(self.shuffler)
 

@@ -350,6 +350,36 @@ def _export_wire_knobs(config: Any) -> None:
             _exported_wire_vars.discard(var)
 
 
+#: Shuffle env vars THIS process exported from a config (never user-set
+#: ones) — the _export_wire_knobs precedent.
+_exported_shuffle_vars: set = set()
+
+
+def _export_shuffle_knobs(config: Any) -> None:
+    """Mirror a LoaderConfig's device-shuffle fields into the
+    ``DDL_TPU_DEVICE_SHUFFLE``/``DDL_TPU_SHUFFLE_IMPL`` environment
+    BEFORE producers spawn (the ``_export_wire_knobs`` pattern):
+    PROCESS/MULTIHOST workers resolve the gate from the environment
+    they inherit.  Default-valued fields ("auto"/"ring") state no
+    opinion: they leave USER-set environment untouched but clear this
+    process's own prior exports.
+    """
+    if config is None:
+        return
+    for var, value, default in (
+        ("DDL_TPU_DEVICE_SHUFFLE",
+         getattr(config, "device_shuffle", "auto"), "auto"),
+        ("DDL_TPU_SHUFFLE_IMPL",
+         getattr(config, "shuffle_impl", "ring"), "ring"),
+    ):
+        if value and str(value) != default:
+            os.environ[var] = str(value)
+            _exported_shuffle_vars.add(var)
+        elif var in _exported_shuffle_vars:
+            os.environ.pop(var, None)
+            _exported_shuffle_vars.discard(var)
+
+
 class WorkerSet:
     """The spawned producer workers + consumer-side connection."""
 
@@ -530,6 +560,7 @@ def distributed_dataloader(
             depth = nslots or envspec.get("DDL_TPU_NSLOTS")
             _export_cache_knobs(config)
             _export_wire_knobs(config)
+            _export_shuffle_knobs(config)
             workers = WorkerSet(topology, depth, shuffler_factory)
             env = DDL_Env(
                 topology=topology, connection=workers.connection,

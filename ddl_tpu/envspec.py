@@ -171,6 +171,15 @@ _EXPLICIT: List[Knob] = [
        "Lossless wire codec for the shuffle exchange + shard reads "
        "(none = explicit off; unset = no opinion).",
        export="wire", config_field="wire_codec"),
+    # -- global shuffle --------------------------------------------------
+    _K("DDL_TPU_DEVICE_SHUFFLE", "str", "auto",
+       "Device-tier exchange gate: auto = engage when plannable (THREAD "
+       "topology, raw wire, in-process fabric), 0/off/false = host "
+       "exchange only.", export="shuffle", config_field="device_shuffle"),
+    _K("DDL_TPU_SHUFFLE_IMPL", "str", "ring",
+       "Device exchange implementation: ring = Pallas remote-DMA ring "
+       "(double-buffered, slot-ridable), xla = jitted ppermute lanes.",
+       export="shuffle", config_field="shuffle_impl"),
     # -- readers ---------------------------------------------------------
     _K("DDL_TPU_TFRECORD_CRC", "bool", True,
        "CRC32C verification of TFRecord length/payload frames."),

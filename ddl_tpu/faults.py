@@ -43,6 +43,16 @@ Injection points shipped today (site — fault kinds that act there):
 ``staging.copy``          staging-copy failure / source corruption
 ``staging.transfer``      staged-transfer failure / timeout (delay)
 ``shuffle.exchange``      peer loss (partner never posts its half)
+``shuffle.device_exchange``  device-tier exchange, once per participant
+                          per round (``DeviceExchangeFabric.exchange``,
+                          before the post): ``ICI_DMA_FAIL`` poisons
+                          the ROUND — every participant latches the
+                          host exchange together with lanes unmutated,
+                          so the host re-run is byte-identical
+                          (``shuffle.device_fallbacks``);
+                          ``SHUFFLE_PEER_LOSS`` keeps this participant
+                          from ever posting, so its peers time out and
+                          degrade via the seeded node-local rung
 ``watchdog.sweep``        spurious shutdown / crash inside ``check_once``
 ``cache.disk_read``       cache-entry corruption (bytes flipped in a
                           just-read disk-tier entry, BEFORE verification —

@@ -1,0 +1,392 @@
+"""Pallas device-side global shuffle: the epoch exchange on the mesh.
+
+``ThreadExchangeShuffler`` moves the two exchange lanes peer-to-peer on
+the HOST — host memcpys and DCN hops on data that is about to be H2D'd
+anyway (ROADMAP item 2).  These kernels run the same permutation
+exchange ON-DEVICE: each instance's exchange block (lane A + lane B,
+``2 * half`` rows) lands once on its ring device, and two remote-DMA
+steps move lane A forward along the shared permutation (``i -> p[i]``)
+and lane B backward (``i -> pinv[i]``) — byte-identical to the host
+rendezvous exchange because both sides derive the permutation from
+``exchange_permutation(n, seed, round)`` (ddl_tpu.shuffle).
+
+Kernel shape constraints (the ``ops/ici_fanout.py`` discipline):
+
+- **Permutation-shaped steps.**  Interpret mode (the CPU virtual-mesh
+  tier-1 path) discharges a remote DMA as a collective: every device in
+  the axis must execute every ``dma_start`` in lockstep, and each
+  step's target map must deliver exactly one copy per device.  An
+  exchange permutation is bijective (and a derangement), so both lane
+  steps are valid target maps by construction — no clamping or sink
+  chunks needed, unlike the fan-out ring.
+- **Scalar-prefetch routes.**  The permutation changes every round;
+  baking it into the kernel would recompile per round.  The routes
+  array ``[p, pinv]`` (2, n) int32 rides scalar prefetch instead
+  (``PrefetchScalarGridSpec(num_scalar_prefetch=1)``), so one compiled
+  program serves every round of a geometry and ``device_id`` is read
+  from SMEM per step.
+- **Double buffering.**  DMA semaphores are parity pairs
+  (``sem[t % 2]``): step ``t`` starts its send, then waits step
+  ``t-1``'s — lane B crosses the links while lane A's send drains
+  (the ``ici_fanout`` idiom; the waited descriptor's slice/target are
+  irrelevant, only its semaphore is consumed).
+- **Landing slots.**  Two concurrently-running collective kernels on a
+  chip must not share barrier semaphores, so the exchange reserves its
+  own per-slot Mosaic ``collective_id`` pair — distinct from the
+  fan-out's (11, 13)/(12, 14) — and callers riding a landing slot
+  alternate ``slot`` exactly like ``fanout_start``/``fanout_wait``.
+  The split surface is :func:`exchange_start` / :func:`exchange_wait`:
+  start dispatches the ring program device-side and returns
+  immediately; the wait is the consumer's first use of the value.
+
+Off-TPU the wrappers run ``interpret=True`` (how tier-1 proves byte
+identity against the host path on the CPU virtual mesh); on a pod the
+same kernels compile through Mosaic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ddl_tpu._compat import shard_map
+from ddl_tpu.ops.ici_fanout import (
+    AXIS,
+    N_SLOTS,
+    _check_slot,
+    _ring_mesh,
+    interpret_default,
+)
+
+#: Mosaic collective ids for the exchange kernel, indexed by landing
+#: slot — must differ from every other collective kernel that can be in
+#: flight on the chip at the same time (the fan-out holds 11-14).
+_EXCHANGE_COLLECTIVE_IDS = (15, 16)
+
+#: The two lane steps of one exchange round (grid size): step 0 moves
+#: lane A along ``p``, step 1 moves lane B along ``pinv``.
+_N_LANES = 2
+
+
+def _exchange_kernel(routes_ref, in_ref, out_ref, send_sem, recv_sem, *,
+                     half: int):
+    """One exchange round: two permutation-shaped remote-DMA steps.
+
+    ``routes_ref`` is the scalar-prefetched (2, n) int32 ``[p, pinv]``;
+    step ``t`` sends this device's rows ``[t*half, (t+1)*half)`` to
+    device ``routes[t, me]`` and receives the same lane slice from its
+    inverse — a full permutation per step, so interpret mode's
+    one-copy-per-device lockstep invariant holds by construction.
+    """
+    t = pl.program_id(0)
+    last_t = pl.num_programs(0) - 1
+    me = lax.axis_index(AXIS)
+
+    def _send_op(step):
+        # Slice + target always describe the CURRENT step's lane; the
+        # parity wait below only consumes step t-1's send semaphore, for
+        # which the descriptor's slice/target are irrelevant (the
+        # ici_fanout idiom).
+        return pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[pl.ds(t * half, half)],
+            dst_ref=out_ref.at[pl.ds(t * half, half)],
+            send_sem=send_sem.at[step % 2],
+            recv_sem=recv_sem.at[step % 2],
+            device_id=routes_ref[t, me],
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    op = _send_op(t)
+    op.start()
+    op.wait_recv()
+
+    # Double buffer: start step t's DMA before draining step t-1's —
+    # lane B is on the links while lane A's send completes.
+    @pl.when(t >= 1)
+    def _wait_prev():
+        _send_op(t - 1).wait_send()
+
+    @pl.when(t == last_t)
+    def _drain():
+        _send_op(t).wait_send()
+
+
+@functools.lru_cache(maxsize=64)
+def _exchange_call(devices: Tuple[Any, ...], half: int, cols: int,
+                   dtype_name: str, interpret: bool, slot: int = 0):
+    """Jitted shard_map'ed ring exchange over ``devices``: inputs are
+    the (2, n) int32 routes (replicated) and the global
+    (n * 2 * half, cols) P(x) lane blocks; output has the same global
+    shape with both lanes exchanged.  Cached per geometry — the routes
+    are DATA, so every round of a geometry reuses one program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _ring_mesh(devices)
+    dtype = np.dtype(dtype_name)
+    kern = functools.partial(_exchange_kernel, half=half)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(_N_LANES,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))] * 2,
+    )
+    call = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((_N_LANES * half, cols), dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=_EXCHANGE_COLLECTIVE_IDS[slot]
+        ),
+    )
+    fn = shard_map(
+        call, mesh=mesh, in_specs=(P(None, None), P(AXIS)),
+        out_specs=P(AXIS), check_vma=False,
+    )
+    spec = NamedSharding(mesh, P(AXIS))
+    rspec = NamedSharding(mesh, P(None, None))
+    return jax.jit(fn, in_shardings=(rspec, spec), out_shardings=spec)
+
+
+@functools.lru_cache(maxsize=64)
+def _exchange_xla_call(devices: Tuple[Any, ...], half: int, cols: int,
+                       dtype_name: str, perm: Tuple[int, ...]):
+    """XLA reference variant: two ``lax.ppermute`` lanes over the ring
+    mesh (the ``parallel.collectives._build_sendrecv_step`` idiom on the
+    producer-side block layout).  Cached per permutation — the A/B
+    baseline and the non-Pallas fallback impl."""
+    from jax import numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddl_tpu.shuffle import inverse_permutation
+
+    mesh = _ring_mesh(devices)
+    p = np.array(perm)
+    pinv = inverse_permutation(p)
+    fwd = tuple((int(i), int(pi)) for i, pi in enumerate(p))
+    bwd = tuple((int(i), int(pi)) for i, pi in enumerate(pinv))
+
+    def shard_fn(block):
+        # block: (2 * half, cols) — this instance's lane A + lane B.
+        a = lax.ppermute(block[:half], AXIS, fwd)
+        b = lax.ppermute(block[half:], AXIS, bwd)
+        return jnp.concatenate([a, b], axis=0)
+
+    fn = shard_map(
+        shard_fn, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+        check_vma=False,
+    )
+    spec = NamedSharding(mesh, P(AXIS))
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
+def as_exchange_input(blocks: Sequence[np.ndarray],
+                      devices: Sequence[Any]) -> Any:
+    """Land per-instance lane blocks on their ring devices and assemble
+    the SPMD global (n * 2 * half, cols) P(x) input — the H2D landing
+    edge of the exchange (the host touches the rows exactly once; every
+    subsequent hop rides ICI)."""
+    devices = tuple(devices)
+    n_dev = len(devices)
+    if len(blocks) != n_dev:
+        raise ValueError(
+            f"need one lane block per ring device ({n_dev}), got "
+            f"{len(blocks)}"
+        )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rows, cols = blocks[0].shape
+    shards = [jax.device_put(b, d) for b, d in zip(blocks, devices)]
+    return jax.make_array_from_single_device_arrays(
+        (n_dev * rows, cols),
+        NamedSharding(_ring_mesh(devices), P(AXIS)),
+        shards,
+    )
+
+
+def exchange_output_blocks(out: Any,
+                           devices: Sequence[Any]) -> List[np.ndarray]:
+    """Fetch the exchanged lane blocks back to the host, one per ring
+    position — the D2H edge where the fabric hands rows back to each
+    producer's private pool (the exchange's only other host touch)."""
+    devices = tuple(devices)
+    n_dev = len(devices)
+    rows = out.shape[0] // n_dev
+    by_start: Dict[int, Any] = {
+        (s.index[0].start or 0): s.data for s in out.addressable_shards
+    }
+    return [np.asarray(by_start[i * rows]) for i in range(n_dev)]
+
+
+def exchange_ring(gin: Any, devices: Sequence[Any], routes: np.ndarray,
+                  interpret: Optional[bool] = None, slot: int = 0) -> Any:
+    """Run one Pallas ring exchange round over the assembled global
+    input.  ``routes`` is the (2, n) int32 ``[p, pinv]`` for this round
+    (data, not code — no per-round recompile).  ``slot`` selects the
+    landing slot (collective-id pair), as in ``fanout_replicate``."""
+    devices = tuple(devices)
+    slot = _check_slot(slot)
+    n_dev = len(devices)
+    if n_dev == 1:
+        return gin
+    if interpret is None:
+        interpret = interpret_default(devices)
+    rows = gin.shape[0] // n_dev
+    half = rows // _N_LANES
+    routes = np.ascontiguousarray(routes, dtype=np.int32)
+    if routes.shape != (_N_LANES, n_dev):
+        raise ValueError(
+            f"routes must be (2, {n_dev}) [p, pinv], got {routes.shape}"
+        )
+    call = _exchange_call(
+        devices, half, gin.shape[1], np.dtype(gin.dtype).name, interpret,
+        slot,
+    )
+    return call(routes, gin)
+
+
+def exchange_xla(gin: Any, devices: Sequence[Any],
+                 perm: Sequence[int]) -> Any:
+    """Run one XLA ``ppermute`` exchange round (the A/B baseline and
+    the ``shuffle_impl=xla`` path) over the assembled global input."""
+    devices = tuple(devices)
+    n_dev = len(devices)
+    if n_dev == 1:
+        return gin
+    rows = gin.shape[0] // n_dev
+    half = rows // _N_LANES
+    call = _exchange_xla_call(
+        devices, half, gin.shape[1], np.dtype(gin.dtype).name,
+        tuple(int(x) for x in perm),
+    )
+    return call(gin)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeTicket:
+    """A started (dispatched, possibly still in flight) exchange round.
+
+    ``value`` is the kernel output as an ASYNC device value — the ring
+    program is enqueued at :func:`exchange_start` and its DMA
+    semaphores are hardware-waited, so the exchange hides under
+    whatever step is running (the ``FanoutTicket`` discipline: at most
+    one in-flight round per ``slot``)."""
+
+    value: Any
+    impl: str  #: "ring" | "xla"
+    slot: int
+
+
+def exchange_start(impl: str, gin: Any, devices: Sequence[Any],
+                   perm: Sequence[int], *, slot: int = 0,
+                   interpret: Optional[bool] = None) -> ExchangeTicket:
+    """Start an exchange round into landing slot ``slot``; never waits.
+
+    The start half of the split start/wait surface (the PR-12
+    ``fanout_start``/``fanout_wait`` + ``gate_release_on`` protocol):
+    the round's ring program is dispatched here and runs under the
+    in-flight train step — a shuffle the trainer never waits for.
+    Pair with :func:`exchange_wait`."""
+    slot = _check_slot(slot)  # fail BEFORE dispatching side effects
+    if impl == "ring":
+        from ddl_tpu.shuffle import inverse_permutation
+
+        p = np.asarray(perm)  # ddl-lint: disable=DDL016 - scalar-prefetch route table (host metadata), not window rows
+        routes = np.stack([p, inverse_permutation(p)]).astype(np.int32)
+        out = exchange_ring(
+            gin, devices, routes, interpret=interpret, slot=slot
+        )
+    elif impl == "xla":
+        out = exchange_xla(gin, devices, perm)
+    else:
+        raise ValueError(f"impl must be ring|xla, got {impl!r}")
+    return ExchangeTicket(value=out, impl=impl, slot=slot)
+
+
+def exchange_wait(ticket: ExchangeTicket, sync: bool = False) -> Any:
+    """The wait half: the real wait is the DATA DEPENDENCE — the first
+    use of the returned value drains the slot's DMA semaphores on
+    device.  ``sync=True`` forces a host ``block_until_ready`` (the
+    fabric's bring-up/fallback boundary, where an async DMA failure
+    must surface inside the degradation ladder rather than at a remote
+    consumer's sync point)."""
+    if sync:
+        jax.block_until_ready(ticket.value)
+    return ticket.value
+
+
+def exchange_wire_bytes(n: int, half: int, cols: int, dtype: Any) -> int:
+    """Raw bytes one device round moves over ICI links: two lanes of
+    ``half`` rows per device, every device sending each step — the
+    honest numerator for per-leg utilization math."""
+    if n <= 1 or half < 1:
+        return 0
+    row = cols * np.dtype(dtype).itemsize
+    return _N_LANES * n * half * row
+
+
+def plan_exchange(n: int, num_exchange: int, cols: int, dtype: Any,
+                  wire_dtype: Optional[str] = None,
+                  n_devices: Optional[int] = None) -> Dict[str, Any]:
+    """Price one exchange round, per leg, device vs host.
+
+    The host path's DCN-tier legs may ride the PR-13 wire
+    (``plan_distribution(wire_dtype=)`` composition): its per-row cost
+    is the ENCODED row + its scale stripe (``parallel.ici.wire_cols``),
+    while the device legs move raw rows over ICI (on-device lossy
+    re-quantization would break the exchange's exact byte identity, so
+    the device tier only engages on the raw wire).  ``plannable`` is
+    the geometry gate the shuffler consults before its first round —
+    an unplannable geometry latches the host fallback for the
+    shuffler's life (``shuffle.device_fallbacks``)."""
+    from ddl_tpu import wire as _wire
+    from ddl_tpu.parallel.ici import wire_cols
+
+    dtype = np.dtype(dtype)
+    half = num_exchange // 2
+    wd = _wire.resolve_wire_dtype(wire_dtype)
+    if wd != "raw" and not _wire.lossy_supported(dtype):
+        wd = "raw"
+    raw_row = cols * dtype.itemsize
+    host_row = wire_cols(cols, dtype, wd)
+    legs = []
+    for lane in ("lane_a", "lane_b"):
+        legs.append({
+            "leg": lane,
+            "rows": n * half,
+            "ici_bytes": n * half * raw_row,
+            "host_bytes_raw": n * half * raw_row,
+            "host_bytes_wire": n * half * host_row,
+        })
+    plannable = n >= 2 and half >= 1
+    why = None
+    if n < 2:
+        why = "single instance: nothing to exchange"
+    elif half < 1:
+        why = f"num_exchange {num_exchange} leaves no lane rows"
+    if plannable and n_devices is not None and n_devices < n:
+        plannable = False
+        why = (
+            f"ring needs {n} devices for {n} instances, have {n_devices}"
+        )
+    return {
+        "plannable": plannable,
+        "why_not": why,
+        "n": n,
+        "half": half,
+        "cols": cols,
+        "dtype": dtype.name,
+        "wire_dtype": wd,
+        "legs": legs,
+        "ici_bytes": sum(leg["ici_bytes"] for leg in legs),
+        "host_bytes_raw": sum(leg["host_bytes_raw"] for leg in legs),
+        "host_bytes_wire": sum(leg["host_bytes_wire"] for leg in legs),
+    }

@@ -180,18 +180,25 @@ def _ring_order(mesh: Any, split_axes: Tuple[str, ...],
     return tuple(np.transpose(mesh.devices, order).reshape(-1))
 
 
-def _wire_cols(cols: int, dtype: Any, wire_dtype: str) -> int:
+def wire_cols(cols: int, dtype: Any, wire_dtype: str) -> int:
     """uint8 columns of one encoded 2D row: the payload bytes plus (for
     int8) the per-row fp32 block scales — scales travel WITH their rows
     so any row split carries its own decode state.  Delegates to THE
     size formulas in ``ddl_tpu.wire`` (one row = a (1, cols) window),
     so the plan's pricing can never drift from what the encode
-    actually produces."""
+    actually produces.  Public: the device-shuffle planner
+    (``ops/device_shuffle.plan_exchange``) prices the host path's
+    wire-encoded DCN legs with the same formula the distribution plan
+    uses, so the two tiers' accounting cannot diverge."""
     from ddl_tpu import wire
 
     return wire.encoded_nbytes(
         (1, cols), dtype, wire_dtype
     ) + wire.scale_bytes_for((1, cols), wire_dtype)
+
+
+#: Backwards-compatible private alias (pre-device-shuffle call sites).
+_wire_cols = wire_cols
 
 
 def plan_distribution(

@@ -8,7 +8,7 @@ same permutation independently from a common seed (reference
 must have no self-sends and no 2-cycles (reference ``shuffle.py:52-72``),
 except n=2 where the swap is the only option (reference ``shuffle.py:44-48``).
 
-Three transports implement the exchange, by span:
+Four transports implement the exchange, by span:
 
 - :class:`Rendezvous` (span ``"thread"``) — in-process board for
   THREAD-mode simulated multi-instance topologies and unit tests.
@@ -16,12 +16,18 @@ Three transports implement the exchange, by span:
   with atomic rename, for PROCESS-mode producers in different OS
   processes on ONE host (the reference's exchange ran between producer
   *processes*, reference ``shuffle.py:92-108`` over ``comm_nth_pusher``).
-- ``ddl_tpu.parallel.collectives`` (span ``"global"``) — the TPU path:
-  ``ppermute`` / ``all_to_all`` over the instance mesh axis riding
-  ICI/DCN, replacing the reference's ``Sendrecv_replace``
-  (``shuffle.py:92-108``).  The ONLY host-spanning option: host-side
-  rendezvous cannot cross hosts, and ``DataPusher`` rejects that
-  combination at handshake rather than stalling.
+- :class:`DeviceExchangeFabric` (span ``"device"``) — the producer-side
+  device tier (:class:`DeviceExchangeShuffler`): lanes land once on the
+  ring devices and the permutation exchange itself rides ICI as a
+  Pallas remote-DMA ring or an XLA ``ppermute``
+  (``ddl_tpu.ops.device_shuffle``), byte-identical to the host paths
+  and latching back to them on any device failure.
+- ``ddl_tpu.parallel.collectives`` (span ``"global"``) — the
+  trainer-side window hook: ``ppermute`` / ``all_to_all`` over the
+  instance mesh axis riding ICI/DCN, replacing the reference's
+  ``Sendrecv_replace`` (``shuffle.py:92-108``).  The ONLY host-spanning
+  option: host-side rendezvous cannot cross hosts, and ``DataPusher``
+  rejects that combination at handshake rather than stalling.
 
 Unlike the reference — where the registered shuffler was unreachable dead
 code (SURVEY Q1) and the alternative strategy lived in a commented-out
@@ -42,7 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ddl_tpu.exceptions import DDLError, ShutdownRequested
+from ddl_tpu.exceptions import DDLError, InjectedFault, ShutdownRequested
 from ddl_tpu.faults import fault_point
 from ddl_tpu.observability import metrics as default_metrics
 from ddl_tpu.types import Topology
@@ -769,6 +775,488 @@ class ExchangeShufflerFactory:
             num_exchange,
             exchange_method,
             rendezvous=self.rendezvous,
+            seed=self.seed,
+            exchange_timeout_s=self.exchange_timeout_s,
+            degrade_on_peer_loss=self.degrade_on_peer_loss,
+            max_peer_losses=self.max_peer_losses,
+            wire_dtype=self.wire_dtype,
+            codec=self.codec,
+            codec_level=self.codec_level,
+        )
+
+
+# -- device-side exchange tier (ddl_tpu.ops.device_shuffle) -------------------
+
+
+class DeviceExchangeError(DDLError):
+    """The device exchange leg failed (DMA failure, unplannable
+    geometry, injected fault): every participant of the round sees it
+    and latches the HOST exchange for the shuffler's life
+    (``shuffle.device_fallbacks``) — distinct from a peer timeout,
+    which degrades one round to the seeded node-local shuffle."""
+
+
+class _DeviceRound:
+    """One (producer_idx, round) exchange round on the fabric board."""
+
+    __slots__ = ("n", "seed", "round_", "posts", "results", "error")
+
+    def __init__(self, n: int, seed: int, round_: int) -> None:
+        self.n = n
+        self.seed = seed
+        self.round_ = round_
+        self.posts: Dict[int, np.ndarray] = {}
+        self.results: Optional[Dict[int, np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+
+
+class DeviceExchangeFabric:
+    """In-process coordination board for the device exchange.
+
+    Every instance's k-th producer posts its lane block per round; the
+    arrival that completes the set runs the DEVICE leg (land blocks on
+    the ring devices, one ``exchange_start``/``exchange_wait`` round
+    over ICI, fetch results) and publishes per-instance results — one
+    collective per round instead of ``2n`` host mailbox hops.
+
+    Reach: producers in THIS process (the THREAD-mode realisation,
+    which is also where the consumer's devices are addressable).  The
+    factory drops the fabric at the pickle boundary, so PROCESS/
+    MULTIHOST workers resolve the device tier off and run the host
+    exchange — same bytes, by the shared-seed construction.
+
+    Round results are RETAINED until round ``r + 2`` starts (the host
+    fabrics' ``retire`` window), so a respawned producer replaying its
+    crashed predecessor's round re-takes the same result —
+    ``supports_elastic_replay`` holds for the device tier too.
+    """
+
+    span = "device"
+
+    def __init__(self, devices: Optional[Sequence[Any]] = None,
+                 impl: Optional[str] = None,
+                 interpret: Optional[bool] = None) -> None:
+        from ddl_tpu import envspec
+
+        self.impl = impl or envspec.get("DDL_TPU_SHUFFLE_IMPL")
+        if self.impl not in ("ring", "xla"):
+            raise ValueError(
+                f"shuffle_impl must be ring|xla, got {self.impl!r}"
+            )
+        self.interpret = interpret
+        self._devices = tuple(devices) if devices is not None else None
+        self._cond = named_condition("shuffle.device.cond")
+        # (producer_idx, round) -> _DeviceRound; swept two rounds behind
+        # the newest (the retire window), so growth is bounded by
+        # 2 * n_producers.  # ddl-lint: disable=DDL013
+        self._rounds: Dict[Tuple[int, int], _DeviceRound] = {}
+
+    # -- geometry ------------------------------------------------------------
+
+    def _ring_devices(self, n: int) -> Tuple[Any, ...]:
+        """The first ``n`` addressable devices as the exchange ring
+        (resolved lazily: constructing the fabric must not import
+        jax)."""
+        if self._devices is None:
+            import jax
+
+            self._devices = tuple(jax.devices())
+        if len(self._devices) < n:
+            raise DDLError(
+                f"device exchange unplannable: ring needs {n} devices "
+                f"for {n} instances, have {len(self._devices)}"
+            )
+        return self._devices[:n]
+
+    # -- the exchange --------------------------------------------------------
+
+    def exchange(self, *, producer_idx: int, round_: int,
+                 instance_idx: int, n: int, block: np.ndarray, seed: int,
+                 timeout_s: float = 60.0,
+                 should_abort: Optional[Callable[[], bool]] = None,
+                 ) -> np.ndarray:
+        """Post this instance's lane block for ``round_`` and return the
+        exchanged block.  Raises :class:`ShutdownRequested` (abort),
+        :class:`DeviceExchangeError` (device leg failed — caller
+        latches the host fallback), or :class:`DDLError` (a peer never
+        posted — caller degrades the round node-locally, exactly the
+        host path's peer-loss rung)."""
+        key = (producer_idx, round_)
+        # Chaos site, hit once per participant per round: ICI_DMA_FAIL
+        # poisons the ROUND (a DMA failure is collective — every
+        # participant must latch the host fallback together, with lanes
+        # unmutated, so the host re-run is byte-identical);
+        # SHUFFLE_PEER_LOSS raises DDLError before this participant
+        # posts, so its peers time out — the seeded node-local rung.
+        try:
+            fault_point(
+                "shuffle.device_exchange", producer_idx=producer_idx,
+                should_abort=should_abort,
+            )
+        except InjectedFault as e:
+            self._fail_round(key, n, seed, e)
+            raise DeviceExchangeError(str(e)) from e
+        run_leg = False
+        with self._cond:
+            self._sweep_rounds(producer_idx, round_)
+            rnd = self._rounds.get(key)
+            if rnd is None:
+                rnd = _DeviceRound(n, seed, round_)
+                self._rounds[key] = rnd
+            if rnd.error is not None:
+                raise DeviceExchangeError(str(rnd.error)) from rnd.error
+            if rnd.results is not None:
+                # Replayed take (respawned producer re-entering a
+                # completed round): idempotent per (key, instance).
+                return rnd.results[instance_idx]
+            rnd.posts[instance_idx] = block
+            run_leg = len(rnd.posts) == n
+            self._cond.notify_all()
+        if run_leg:
+            self._run_device_leg(rnd)
+        deadline = time.monotonic() + timeout_s
+        extended = False
+        with self._cond:
+            while rnd.results is None and rnd.error is None:
+                if should_abort is not None and should_abort():
+                    # Retract our half if the round has not filled (the
+                    # host path's discard-on-shutdown), so a later run
+                    # cannot adopt this round's stale post.
+                    if len(rnd.posts) < rnd.n:
+                        rnd.posts.pop(instance_idx, None)
+                    raise ShutdownRequested()
+                if not extended and len(rnd.posts) == rnd.n:
+                    # All peers posted: the leader is running the device
+                    # leg — the peer-loss clock no longer applies; give
+                    # the leg its own full budget once.
+                    deadline = time.monotonic() + timeout_s
+                    extended = True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    if len(rnd.posts) == rnd.n:
+                        raise DeviceExchangeError(
+                            f"device exchange leg stalled at round "
+                            f"{round_} (producer {producer_idx})"
+                        )
+                    rnd.posts.pop(instance_idx, None)
+                    raise DDLError(
+                        f"device exchange timed out waiting for peers "
+                        f"at round {round_} (producer {producer_idx}: "
+                        f"{len(rnd.posts)}/{rnd.n} posted)"
+                    )
+                self._cond.wait(timeout=min(0.1, remaining))
+            if rnd.error is not None:
+                raise DeviceExchangeError(str(rnd.error)) from rnd.error
+            return rnd.results[instance_idx]
+
+    # -- internals -----------------------------------------------------------
+
+    def _fail_round(self, key: Tuple[int, int], n: int, seed: int,
+                    err: BaseException) -> None:
+        with self._cond:
+            rnd = self._rounds.get(key)
+            if rnd is None:
+                rnd = _DeviceRound(n, seed, key[1])
+                self._rounds[key] = rnd
+            if rnd.results is None and rnd.error is None:
+                rnd.error = err
+            self._cond.notify_all()
+
+    def _sweep_rounds(self, producer_idx: int, round_: int) -> None:
+        """Drop this producer's rounds older than ``round_ - 1`` (the
+        replay window closes one round behind, as on the host fabrics'
+        ``retire``).  Caller holds the condition lock."""
+        stale = [
+            k for k in self._rounds
+            if k[0] == producer_idx and k[1] < round_ - 1
+        ]
+        for k in stale:
+            del self._rounds[k]
+
+    def _run_device_leg(self, rnd: _DeviceRound) -> None:
+        """The arrival that completed the round runs the collective.
+        ANY failure here (unplannable geometry, a DMA error surfacing at
+        the sync point, a dtype the mesh cannot hold) is published to
+        every participant — they all latch the host fallback together."""
+        try:
+            results = self._device_exchange(rnd)
+        except (ShutdownRequested, KeyboardInterrupt):
+            # Teardown interrupts propagate; waiting peers hit the
+            # leg-stall timeout and latch the host fallback.
+            raise
+        except Exception as e:  # published, not swallowed
+            with self._cond:
+                if rnd.results is None and rnd.error is None:
+                    rnd.error = e
+                self._cond.notify_all()
+            return
+        with self._cond:
+            if rnd.error is None:
+                rnd.results = results
+            self._cond.notify_all()
+
+    def _device_exchange(self, rnd: _DeviceRound) -> Dict[int, np.ndarray]:
+        # Lazy: the fabric is importable (and picklable factories must
+        # construct) without pulling jax/pallas into light processes.
+        from ddl_tpu.ops import device_shuffle as _dsh
+
+        n = rnd.n
+        devices = self._ring_devices(n)
+        blocks = []
+        shape = dtype = None
+        for i in range(n):
+            if i not in rnd.posts:
+                raise DDLError(
+                    f"device exchange round {rnd.round_} missing "
+                    f"instance {i}'s lanes"
+                )
+            b = rnd.posts[i]
+            if shape is None:
+                shape, dtype = b.shape, b.dtype
+            elif b.shape != shape or b.dtype != dtype:
+                raise DDLError(
+                    f"device exchange round {rnd.round_}: instance {i} "
+                    f"posted {b.shape}/{b.dtype}, expected "
+                    f"{shape}/{dtype}"
+                )
+            blocks.append(b)
+        p = exchange_permutation(n, rnd.seed, rnd.round_)
+        gin = _dsh.as_exchange_input(blocks, devices)
+        # Alternating landing slots (distinct collective-id pairs) keep
+        # round r+1's ring program off round r's barrier semaphores when
+        # the exchange rides a landing slot under the fused step.
+        ticket = _dsh.exchange_start(
+            self.impl, gin, devices, p,
+            slot=rnd.round_ % _dsh.N_SLOTS, interpret=self.interpret,
+        )
+        # sync=True: an async DMA failure must surface HERE, inside the
+        # fallback ladder, not at some later consumer's sync point.
+        out = _dsh.exchange_wait(ticket, sync=True)
+        blocks_out = _dsh.exchange_output_blocks(out, devices)
+        return {i: blocks_out[i] for i in range(n)}
+
+
+class DeviceExchangeShuffler(ThreadExchangeShuffler):
+    """The device-tier exchange shuffler: same contract, same bytes,
+    one collective instead of ``2n`` host mailbox hops.
+
+    Subclasses :class:`ThreadExchangeShuffler`, inheriting the entire
+    degradation ladder (suspend/resume, peer-loss degrade, elastic
+    rejoin, wire fallback) — the device tier wraps ONLY the healthy
+    round's transport.  Byte identity with the host path is by
+    construction: both derive the permutation from
+    ``exchange_permutation(n, seed + producer_idx, round)`` and move
+    the same two lanes, so for a given seed the post-exchange pools are
+    equal byte-for-byte (the tier-1 parity suite proves it on the CPU
+    virtual mesh in interpret mode).
+
+    Resolution (construction time, not a fallback): the device tier
+    engages only when a fabric is present (the factory drops it at the
+    pickle boundary, so PROCESS/MULTIHOST workers run the host path),
+    the topology is THREAD-realised (the fabric's reach), the
+    ``DDL_TPU_DEVICE_SHUFFLE`` gate is not off, and the wire resolves
+    raw with no codec (the device legs move raw rows over ICI; an
+    explicitly forced lossy/codec wire keeps the host path — on-device
+    re-quantization would break exact byte identity).
+
+    Fallback (latched for the shuffler's life, ``shuffle.device_
+    fallbacks``): unplannable geometry or any device-leg failure —
+    every round participant latches together and re-runs the SAME
+    round over the host fabric with lanes unmutated, byte-identically.
+    A peer that never posts degrades the round to the seeded node-local
+    shuffle, exactly the host path's rung.
+    """
+
+    def __init__(
+        self,
+        topology: Topology,
+        producer_idx: int,
+        num_exchange: int,
+        exchange_method: str = "sendrecv_replace",
+        rendezvous: Any = None,
+        fabric: Optional[DeviceExchangeFabric] = None,
+        device_shuffle: Optional[str] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(
+            topology, producer_idx, num_exchange, exchange_method,
+            rendezvous=rendezvous, **kwargs,
+        )
+        from ddl_tpu import envspec
+        from ddl_tpu.types import RunMode
+
+        gate = (
+            device_shuffle
+            if device_shuffle is not None
+            else (envspec.raw("DDL_TPU_DEVICE_SHUFFLE") or "auto")
+        )
+        self._fabric = fabric
+        self._device_latched = False  # terminal: host exchange for life
+        why = None
+        if str(gate).lower() in envspec.FALSY:
+            why = "DDL_TPU_DEVICE_SHUFFLE gate is off"
+        elif fabric is None:
+            why = (
+                "no fabric (crossed a spawn boundary, or none was "
+                "constructed)"
+            )
+        elif topology.mode is not RunMode.THREAD:
+            why = (
+                f"{topology.mode.value} topology: the in-process fabric "
+                "cannot reach producers in other processes"
+            )
+        elif self.wire_dtype != "raw" or self.codec is not None:
+            why = (
+                f"wire ({self.wire_dtype}/{self.codec}) forced: device "
+                "legs move raw rows over ICI"
+            )
+        self._device_ok = why is None
+        if why is not None and fabric is not None:
+            logger.debug(
+                "device shuffle resolved OFF for producer %d: %s",
+                producer_idx, why,
+            )
+
+    @property
+    def span(self) -> str:
+        """``"device"`` while the device tier is engaged, else the host
+        fabric's span (the handshake validates whichever transport will
+        actually carry the lanes)."""
+        if self._device_ok and not self._device_latched:
+            return "device"
+        return super().span
+
+    @property
+    def device_exchange_active(self) -> bool:
+        return self._device_ok and not self._device_latched
+
+    def _latch_host(self, why: BaseException) -> None:
+        self._device_latched = True
+        self.metrics.incr("shuffle.device_fallbacks")
+        logger.error(
+            "device shuffle: exchange leg failed at round %d (%s) — "
+            "latching the HOST exchange for the rest of the run",
+            self._round, why,
+        )
+
+    def global_shuffle(self, my_ary: np.ndarray, should_abort: Any = None,
+                       **kwargs: Any) -> None:
+        n = self.topology.n_instances
+        if n <= 1 or self.num_exchange < 2:
+            return
+        if (
+            not self._device_ok
+            or self._device_latched
+            or self._degraded
+            or self._suspended
+        ):
+            # Host tier (resolution-off / latched) or the inherited
+            # degrade/suspend rungs — the base class owns all of them.
+            return super().global_shuffle(my_ary, should_abort, **kwargs)
+        lane_a, lane_b = exchange_slices(self.num_exchange)
+        half = lane_a.stop
+        # Both lanes travel as one 2D block; trailing dims flatten into
+        # columns (the device kernel is 2D) and unflatten on return.
+        block = np.ascontiguousarray(
+            my_ary[: 2 * half].reshape(2 * half, -1)
+        )
+        try:
+            out = self._fabric.exchange(
+                producer_idx=self.producer_idx,
+                round_=self._round,
+                instance_idx=self.topology.instance_idx,
+                n=n,
+                block=block,
+                seed=self.seed + self.producer_idx,
+                timeout_s=self.exchange_timeout_s,
+                should_abort=should_abort,
+            )
+        except ShutdownRequested:
+            raise
+        except DeviceExchangeError as e:
+            # Device leg failed for the whole round: latch the host
+            # exchange for life and re-run the SAME round over it —
+            # lanes are unmutated, so the bytes equal a host-only run.
+            self._latch_host(e)
+            return super().global_shuffle(my_ary, should_abort, **kwargs)
+        except DDLError as e:
+            # A peer never posted: the host path's peer-loss rung,
+            # byte-identical because the node-local shuffle depends
+            # only on (seed, producer, round).
+            if not self.degrade_on_peer_loss:
+                raise
+            self._degrade_round(my_ary, e)
+            self._round += 1
+            return
+        my_ary[: 2 * half] = out.reshape(my_ary[: 2 * half].shape)
+        self.metrics.incr("shuffle.device_rounds")
+        self._peer_losses = 0  # a healthy round resets the ladder
+        self._round += 1
+
+    @classmethod
+    def factory(
+        cls,
+        rendezvous: Any = None,
+        fabric: Optional[DeviceExchangeFabric] = None,
+        device_shuffle: Optional[str] = None,
+        shuffle_impl: Optional[str] = None,
+        **kwargs: Any,
+    ) -> "DeviceExchangeShufflerFactory":
+        return DeviceExchangeShufflerFactory(
+            rendezvous=rendezvous, fabric=fabric,
+            device_shuffle=device_shuffle, shuffle_impl=shuffle_impl,
+            **kwargs,
+        )
+
+
+class DeviceExchangeShufflerFactory(ExchangeShufflerFactory):
+    """Picklable device-shuffler factory.
+
+    Constructs one :class:`DeviceExchangeFabric` (shared by every
+    producer it builds in this process) unless given one.  The fabric
+    is an in-process coordination board (named condition + device
+    handles), so :meth:`__getstate__` DROPS it at the pickle boundary:
+    PROCESS/MULTIHOST workers construct with the device tier resolved
+    off and run the host exchange over the factory's ``rendezvous`` —
+    the streams stay byte-identical and no ``shuffle.device_fallbacks``
+    is counted (resolution is not a fallback)."""
+
+    def __init__(
+        self,
+        rendezvous: Any = None,
+        fabric: Optional[DeviceExchangeFabric] = None,
+        device_shuffle: Optional[str] = None,
+        shuffle_impl: Optional[str] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(rendezvous=rendezvous, **kwargs)
+        self.fabric = (
+            fabric
+            if fabric is not None
+            else DeviceExchangeFabric(impl=shuffle_impl)
+        )
+        self.device_shuffle = device_shuffle
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        state["fabric"] = None  # in-process reach only; see class doc
+        return state
+
+    def __call__(
+        self,
+        topology: Topology,
+        producer_idx: int,
+        num_exchange: int,
+        exchange_method: str = "sendrecv_replace",
+    ) -> DeviceExchangeShuffler:
+        return DeviceExchangeShuffler(
+            topology,
+            producer_idx,
+            num_exchange,
+            exchange_method,
+            rendezvous=self.rendezvous,
+            fabric=self.fabric,
+            device_shuffle=self.device_shuffle,
             seed=self.seed,
             exchange_timeout_s=self.exchange_timeout_s,
             degrade_on_peer_loss=self.degrade_on_peer_loss,

@@ -256,6 +256,24 @@ REQUIRED_WIRE = (
     "wire_vs_raw", "link_bytes_per_sec", "rounds",
 )
 REQUIRED_WIRE_LEG = ("samples_per_sec", "wire_bytes", "payload_bytes")
+#: The shuffle block's contract (ISSUE 17: DDL_BENCH_MODE=shuffle —
+#: the host-vs-device global-shuffle exchange A/B).  Byte identity is
+#: the tentpole (same seed ⇒ same post-exchange pools), the winner
+#: rides the never-headline-slower invariant (interpret mode may LOSE
+#: on CPU — the contract stays green, the ici precedent), zero
+#: latched fallbacks (a latch means the "device" timings measured the
+#: host path), and the per-leg wire-byte accounting must be present.
+REQUIRED_SHUFFLE = (
+    "n_instances", "n_devices", "impl", "interpret", "rounds",
+    "bytes_per_s", "winner", "device_bytes_per_s", "host_bytes_per_s",
+    "vs_host", "byte_identical", "plannable", "wire_dtype", "legs",
+    "ici_bytes_per_round", "host_bytes_raw_per_round",
+    "host_bytes_wire_per_round", "device_rounds", "fallbacks",
+)
+REQUIRED_SHUFFLE_LEG = (
+    "leg", "rows", "ici_bytes", "host_bytes_raw", "host_bytes_wire",
+)
+
 #: The preempt block's contract (ISSUE 14: DDL_BENCH_MODE=preempt —
 #: async-vs-sync checkpoint stall, notice→resumed recovery, hard-kill
 #: lost-work bound).  The async stall must be gated near zero vs the
@@ -584,6 +602,92 @@ def main() -> int:
             f"{opt['grad_comm_bytes_raw']}"
         )
         return 1
+    # -- pass 2b2: the device-shuffle exchange A/B (ISSUE 17) ----------
+    sh_result = _run_bench("shuffle")
+    if sh_result is None:
+        return 1
+    sh = sh_result.get("shuffle")
+    if not isinstance(sh, dict):
+        print(json.dumps(sh_result, indent=1))
+        print(
+            "bench-smoke: no shuffle block "
+            f"(errors={sh_result.get('errors')})"
+        )
+        return 1
+    sh_missing = [k for k in REQUIRED_SHUFFLE if k not in sh]
+    if sh_missing:
+        print(json.dumps(sh, indent=1))
+        print(f"bench-smoke: shuffle block missing keys: {sh_missing}")
+        return 1
+    if sh["byte_identical"] is not True:
+        print(json.dumps(sh, indent=1))
+        print(
+            "bench-smoke: device-exchange pools NOT byte-identical to "
+            "the host exchange — the on-mesh permutation changed data"
+        )
+        return 1
+    if sh["plannable"] is not True:
+        print(json.dumps(sh, indent=1))
+        print(
+            "bench-smoke: shuffle exchange unplannable "
+            f"({sh.get('why_not')}) — the A/B never exercised the "
+            "device tier"
+        )
+        return 1
+    # Host-vs-device rides the same never-headline-slower invariant as
+    # the ici pass: interpret mode may well LOSE to the host threads on
+    # CPU — that flips the winner label, never the contract.
+    sh_pair = {
+        "device": sh["device_bytes_per_s"],
+        "host": sh["host_bytes_per_s"],
+    }
+    if sh["bytes_per_s"] < max(sh_pair.values()):
+        print(json.dumps(sh, indent=1))
+        print(
+            f"bench-smoke: shuffle headline {sh['bytes_per_s']} is "
+            f"slower than a path the same run measured ({sh_pair}) — "
+            "never-slower invariant violated"
+        )
+        return 1
+    if sh["winner"] != max(sh_pair, key=sh_pair.get) or (
+        sh_result.get("headline_config") != sh["winner"]
+    ):
+        print(json.dumps(sh, indent=1))
+        print(
+            f"bench-smoke: shuffle winner label {sh['winner']!r} / "
+            f"headline_config {sh_result.get('headline_config')!r} do "
+            f"not name the measured winner ({sh_pair})"
+        )
+        return 1
+    if sh["fallbacks"]:
+        print(json.dumps(sh, indent=1))
+        print(
+            "bench-smoke: shuffle A/B latched the host fallback "
+            f"({sh['fallbacks']} times) — the device timings measured "
+            "the host path"
+        )
+        return 1
+    if not sh["device_rounds"]:
+        print(json.dumps(sh, indent=1))
+        print(
+            "bench-smoke: shuffle A/B recorded zero device rounds — "
+            "the device tier never engaged"
+        )
+        return 1
+    sh_legs = sh["legs"]
+    if not isinstance(sh_legs, list) or not sh_legs:
+        print(json.dumps(sh, indent=1))
+        print("bench-smoke: shuffle block carries no per-leg accounting")
+        return 1
+    for leg in sh_legs:
+        leg_missing = [k for k in REQUIRED_SHUFFLE_LEG if k not in leg]
+        if leg_missing:
+            print(json.dumps(sh, indent=1))
+            print(
+                f"bench-smoke: shuffle leg {leg.get('leg')!r} missing "
+                f"keys: {leg_missing}"
+            )
+            return 1
     # -- pass 2c: topology-aware placement + membership (ISSUE 10) -----
     for attempt in range(1, 3):
         pl_result = _run_bench("placement")
@@ -1139,6 +1243,9 @@ def main() -> int:
         f"opt winner {opt['winner']} vs_replicated "
         f"{opt['vs_replicated']} parity (drift fp32 {opt['loss_drift']} "
         f"int8 {opt['int8_loss_drift']}) state {opt['state_shrink']}x; "
+        f"shuffle winner {sh['winner']} vs_host {sh['vs_host']} "
+        f"(byte-identical, {sh['device_rounds']} device rounds, "
+        "0 fallbacks); "
         f"placement winner {pl['winner']} ratio {pl['ratio']} "
         f"(view_changes={pl['view_changes']}); "
         f"tenancy winner {tn['winner']} vs_static {tn['vs_static']} "

@@ -35,7 +35,7 @@ ART="${1:-bench_artifacts}"
 mkdir -p "$ART"
 STAMP=$(date +%Y%m%d-%H%M%S)
 
-echo "== [1/10] probe =="
+echo "== [1/11] probe =="
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
     > "$ART/probe-$STAMP.txt" 2>&1; then
   echo "TUNNEL DOWN (probe timed out); aborting — rerun later."
@@ -45,23 +45,23 @@ grep -qi "axon\|tpu" "$ART/probe-$STAMP.txt" || {
   echo "probe found no TPU device:"; cat "$ART/probe-$STAMP.txt"; exit 1; }
 echo "tunnel up: $(tail -1 "$ART/probe-$STAMP.txt")"
 
-echo "== [2/10] on-chip test suite =="
+echo "== [2/11] on-chip test suite =="
 DDL_TPU_ONCHIP=1 timeout 3000 python -m pytest tests/test_onchip.py -v \
   2>&1 | tee "$ART/onchip-$STAMP.txt" | tail -15
 
-echo "== [3/10] full bench =="
+echo "== [3/11] full bench =="
 DDL_BENCH_PLATFORM=tpu timeout 3000 python bench.py \
   2> "$ART/bench-full-$STAMP.err" | tee "$ART/bench-full-$STAMP.json"
 
-echo "== [4/10] big-model MFU bench =="
+echo "== [4/11] big-model MFU bench =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big timeout 3000 python bench.py \
   2> "$ART/bench-big-$STAMP.err" | tee "$ART/bench-big-$STAMP.json"
 
-echo "== [4b/10] serving decode bench (small + big, MBU-graded) =="
+echo "== [4b/11] serving decode bench (small + big, MBU-graded) =="
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=decode timeout 1800 python bench.py \
   2> "$ART/bench-decode-$STAMP.err" | tee "$ART/bench-decode-$STAMP.json"
 
-echo "== [5/10] stream-bandwidth diagnosis + window-size sweep =="
+echo "== [5/11] stream-bandwidth diagnosis + window-size sweep =="
 # DDL_BENCH_PLATFORM=tpu everywhere: a mid-checklist tunnel drop must
 # fail loudly (step timeout), never silently record CPU numbers in a
 # TPU artifact.  DDL_BENCH_MODE=stream runs ONLY the two stream configs
@@ -81,7 +81,7 @@ for MIB in 64 128; do
     | tee "$ART/bench-stream-$MIB-$STAMP.json"
 done
 
-echo "== [6/10] ICI fan-out probe + distribution A/B =="
+echo "== [6/11] ICI fan-out probe + distribution A/B =="
 # Real remote-DMA numbers for the device-side distribution tier
 # (ddl_tpu/parallel/ici.py): per-hop bytes/s from the kernel probe,
 # then the ici-vs-xla A/B with link utilization against the per-link
@@ -92,7 +92,7 @@ DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_ici.py \
 DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici timeout 1200 python bench.py \
   2> "$ART/bench-ici-$STAMP.err" | tee "$ART/bench-ici-$STAMP.json"
 
-echo "== [7/10] distributed-optimizer probe + A/B =="
+echo "== [7/11] distributed-optimizer probe + A/B =="
 # The zero1/int8 measurement the ISSUE-8 artifact needs on real HBM:
 # state bytes/replica from placed shardings, the int8 gather leg on
 # real ICI, loss parity re-asserted on-chip.  Then the train_big MFU
@@ -108,7 +108,7 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=big \
   2> "$ART/bench-big-zero1-$STAMP.err" \
   | tee "$ART/bench-big-zero1-$STAMP.json"
 
-echo "== [8/10] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
+echo "== [8/11] fused-step chip A/B (ISSUE 12 / ROADMAP item 2) =="
 # The fused compute/ingest step measured with REAL DMAs: (a) the
 # train-mode fit_stream leg carries the fused-vs-unfused A/B (on TPU
 # the unfused leg exposes the genuine H2D + ICI fan-out latency — no
@@ -130,7 +130,7 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=stream \
   2> "$ART/bench-fused-stream-$STAMP.err" \
   | tee "$ART/bench-fused-stream-$STAMP.json"
 
-echo "== [9/10] wire-format A/B on real ICI/DCN links (ISSUE 13) =="
+echo "== [9/11] wire-format A/B on real ICI/DCN links (ISSUE 13) =="
 # The wire tier re-measured where the links are real: (a) probe_wire on
 # the chip host prices encode/decode CPU against the REAL link speeds
 # (the break_even_link_mib_s table decides whether int8/bf16 pays off
@@ -152,7 +152,7 @@ DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=ici DDL_TPU_WIRE_DTYPE=int8 \
   2> "$ART/bench-ici-wire-$STAMP.err" \
   | tee "$ART/bench-ici-wire-$STAMP.json"
 
-echo "== [10/10] fused-stream Perfetto trace + obs overhead (ISSUE 15) =="
+echo "== [10/11] fused-stream Perfetto trace + obs overhead (ISSUE 15) =="
 # One REAL fused-stream trace for the books: the obs A/B re-priced
 # where windows are genuinely DMA'd (the armed-vs-disarmed ceiling is
 # <= 2% on CPU; confirm it holds when the armed spans sit next to real
@@ -184,5 +184,27 @@ with obs_spans.tracing() as slog:
 print(obs_spans.write_chrome_trace(slog.events(), out),
       f"({len(slog.events())} events)")
 PYEOF
+
+echo "== [11/11] device-shuffle exchange A/B on real ICI (ISSUE 17) =="
+# The global-shuffle epoch exchange measured where the ring DMAs are
+# real: (a) probe_shuffle prices the exchange (device ICI bytes vs the
+# host boards raw/wire) and re-witnesses byte identity for both impls
+# on the pod; (b) the host-vs-device A/B at pod geometry — on-chip the
+# Mosaic ring should WIN (one collective per round vs 2n mailbox hops
+# through host memory; the CPU interpret artifact loses by design),
+# and the JSON's vs_host is the headline the PERF_NOTES section is
+# waiting for; (c) the same A/B with the xla impl for the
+# ppermute-vs-ring gap on real links.  Zero fallbacks required — a
+# latched run means the DMA path failed and the numbers are host
+# numbers (the bench raises on that; treat a raise as a finding, not
+# flake).
+DDL_BENCH_PLATFORM=tpu timeout 600 python tools/probe_shuffle.py \
+  2> "$ART/shuffle-probe-$STAMP.err" | tee "$ART/shuffle-probe-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=shuffle timeout 1200 python bench.py \
+  2> "$ART/bench-shuffle-$STAMP.err" | tee "$ART/bench-shuffle-$STAMP.json"
+DDL_BENCH_PLATFORM=tpu DDL_BENCH_MODE=shuffle DDL_BENCH_SHUFFLE_IMPL=xla \
+  timeout 1200 python bench.py \
+  2> "$ART/bench-shuffle-xla-$STAMP.err" \
+  | tee "$ART/bench-shuffle-xla-$STAMP.json"
 
 echo "== done; artifacts in $ART/ (commit them NOW, tunnel may drop) =="
