@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test
+.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test failover-test failover-bench
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -100,7 +100,7 @@ check: lint verify bench-smoke
 # DMA-failure → xla-fallback rung (tests/test_ici.py) + the preemption
 # notice/checkpoint-corruption rows (tests/test_resilience.py).
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py tests/test_resilience.py tests/test_obs.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_cache.py tests/test_ici.py tests/test_cluster.py tests/test_serve.py tests/test_resilience.py tests/test_obs.py tests/test_supervision.py -q
 
 # Distributed-optimizer suite alone (parity matrix, collective units,
 # the 4B fits-only-with-zero1 accounting test).
@@ -136,6 +136,20 @@ preempt-test:
 # bound — byte-identical resume asserted in the artifact.
 preempt-bench:
 	DDL_BENCH_MODE=preempt JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Survivable-control-plane suite alone (supervisor journal replay,
+# the acked/fenced envelope seam, lease-expiry HA promotion incl. the
+# split-brain row, scheduler-fairness continuity, the mid-stream
+# supervisor-kill e2e; docs/ROBUSTNESS.md "Control-plane failover").
+failover-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_supervision.py -q
+
+# Control-plane failover priced end to end: mid-stream supervisor kill
+# with standby takeover wall time as the headline — byte-identical
+# stream, zero watchdog failures, envelope drop/dup dedup counters and
+# scheduler-fairness continuity asserted in the artifact.
+failover-bench:
+	DDL_BENCH_MODE=failover JAX_PLATFORMS=cpu $(PY) bench.py
 
 # Host-vs-device global-shuffle exchange A/B (ThreadExchangeShuffler
 # over the rendezvous boards vs the on-mesh DeviceExchangeShuffler;

@@ -1805,6 +1805,288 @@ def _run_preempt_ab() -> dict:
     }
 
 
+def _run_failover_ab() -> dict:
+    """Control-plane failover priced end to end (ISSUE 18).
+
+    Four legs over the 2-mock-host THREAD cluster geometry (the
+    tests/test_cluster.py shard ladder — control-plane cost, not data
+    volume, is the thing measured):
+
+    1. **Steady-state reference** (deterministic): journaled supervisor
+       + HA stepper, leader never killed — the per-shard CRC window
+       stream is the byte-identity baseline.
+    2. **Mid-stream supervisor kill** (measured): the HA leader dies at
+       a fixed epoch boundary; the standby's lease-expiry promotion
+       replays the journal, re-fences the control channel, and re-sends
+       adoptions.  ``takeover_s`` (promotion wall time + lease
+       overshoot) is the headline; the window stream must complete
+       BYTE-IDENTICAL to leg 1 with zero watchdog failures and the
+       journal's replayed term at 2.
+    3. **Envelope chaos** (deterministic counters): a host-loss
+       adoption wired under ``CONTROL_MSG_DROP`` + ``CONTROL_MSG_DUP``
+       at ``transport.control_send`` — the drop is absorbed by the
+       acked seam's backoff retry, the dup by ``(incarnation, seq)``
+       dedup (applied once, re-acked), full-shard coverage still
+       byte-identical.
+    4. **Scheduler fairness across the handover** (deterministic): the
+       fake-clock admission script — export→adopt roundtrips bit-exact
+       and the promoted scheduler grants the SAME order the
+       uninterrupted one would have.
+    """
+    import tempfile
+    import zlib as _zlib
+
+    from ddl_tpu import (
+        DataProducerOnInitReturn,
+        DistributedDataLoader,
+        Marker,
+        ProducerFunctionSkeleton,
+        distributed_dataloader,
+    )
+    from ddl_tpu import faults
+    from ddl_tpu.cluster import (
+        ClusterView,
+        ElasticCluster,
+        HostInfo,
+        JournaledSupervisor,
+        SupervisorHA,
+        replay_journal,
+    )
+    from ddl_tpu.exceptions import StallTimeoutError
+    from ddl_tpu.faults import FaultKind, FaultPlan, FaultSpec
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.serve import TenantSpec
+    from ddl_tpu.serve.tenancy import FairShareScheduler
+    from ddl_tpu.watchdog import Watchdog
+
+    n_shards, rows, vals = 4, 8, 4
+    n_epochs, kill_after = 8, 2
+    lease_s = 0.3
+
+    def shard_pattern(shard):
+        return (
+            shard * 1000.0
+            + np.arange(rows * vals, dtype=np.float32) % 97
+        ).reshape(rows, vals)
+
+    class _ShardProducer(ProducerFunctionSkeleton):
+        def __init__(self, ranges_by_producer):
+            self.ranges_by_producer = dict(ranges_by_producer)
+            self.ranges = ()
+
+        def _shards(self):
+            return [s for a, b in self.ranges for s in range(a, b)]
+
+        def on_init(self, producer_idx=1, **kw):
+            self.it = 0
+            self.ranges = tuple(self.ranges_by_producer[producer_idx])
+            return DataProducerOnInitReturn(
+                nData=rows, nValues=vals, shape=(rows, vals),
+                splits=(vals,),
+            )
+
+        def post_init(self, my_ary, **kw):
+            my_ary[:] = 0.0
+
+        def execute_function(self, my_ary, **kw):
+            shards = self._shards()
+            my_ary[:] = shard_pattern(shards[self.it % len(shards)])
+            self.it += 1
+
+        def adopt_shards(self, ranges, **kw):
+            self.ranges = tuple(ranges)
+
+    def two_host_view():
+        return ClusterView.bootstrap(
+            [
+                HostInfo(0, loader_ranks=(1,), trainer_ranks=(0,)),
+                HostInfo(1, loader_ranks=(2,)),
+            ],
+            n_shards=n_shards,
+        )
+
+    base = tempfile.mkdtemp(prefix="ddl-failover-")
+
+    def drain(journal_path, m, *, kill=False, plan=None, kill_host=None,
+              n=n_epochs, pace_s=0.0):
+        """Run the pipeline; returns (crcs-by-shard, seen-by-shard, ha)."""
+        producer = _ShardProducer({1: ((0, 2),), 2: ((2, 4),)})
+        # Per-shard CRC streams: within one shard the order is the
+        # producer's deterministic cycle, immune to cross-producer
+        # interleave timing.
+        crcs: dict = {}
+
+        @distributed_dataloader(n_producers=2, mode="thread")
+        def run(env):
+            sup = JournaledSupervisor(
+                two_host_view(), journal=journal_path, lease_s=30.0,
+                poll_interval_s=0.05, metrics=m,
+            )
+            elastic = ElasticCluster(sup, workers=env.workers, metrics=m)
+            ha = SupervisorHA(
+                sup, elastic=elastic, lease_s=lease_s, standbys=1,
+                metrics=m,
+            ).start()
+            loader = DistributedDataLoader(
+                producer, batch_size=rows, connection=env.connection,
+                n_epochs=n, output="numpy", timeout_s=60.0, metrics=m,
+                cluster=elastic,
+            )
+            wd = Watchdog(
+                env.workers, poll_interval_s=0.05, stall_budget_s=60.0,
+                respawn=True, metrics=m,
+            ).start()
+            seen: dict = {}
+            try:
+                for ep in range(n):
+                    for (win,) in loader:
+                        shard = int(win[0, 0] // 1000)
+                        crcs.setdefault(shard, []).append(
+                            _zlib.crc32(
+                                np.ascontiguousarray(win).tobytes()
+                            )
+                        )
+                        seen.setdefault(shard, []).append(win.copy())
+                        loader.mark(Marker.END_OF_BATCH)
+                    loader.mark(Marker.END_OF_EPOCH)
+                    if pace_s:
+                        time.sleep(pace_s)
+                    if kill and ep == kill_after:
+                        ha.kill_leader()
+                    if kill and ep == kill_after + 1:
+                        deadline = time.monotonic() + 10.0
+                        while ha.leader is None:
+                            if time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    "standby never promoted"
+                                )
+                            time.sleep(0.02)
+                    if kill_host is not None and ep == kill_host:
+                        elastic.kill_host(1)
+            finally:
+                wd.stop()
+                ha.stop()
+            return seen, ha
+
+        if plan is not None:
+            with faults.armed(plan):
+                seen, ha = run()
+        else:
+            seen, ha = run()
+        return crcs, seen, ha
+
+    # -- legs 1+2: steady-state vs mid-stream supervisor kill ----------
+    m_ref = Metrics()
+    crcs_ref, _, _ = drain(os.path.join(base, "ref.jrn"), m_ref)
+    m_b = Metrics()
+    crcs_b, _, ha_b = drain(
+        os.path.join(base, "kill.jrn"), m_b, kill=True,
+    )
+    if ha_b.last_takeover_s is None:
+        raise RuntimeError("HA leader kill never produced a promotion")
+    replayed = replay_journal(os.path.join(base, "kill.jrn"))
+    byte_identical = bool(
+        crcs_b == crcs_ref
+        and sorted(crcs_ref) == list(range(n_shards))
+    )
+
+    # -- leg 3: adoption under envelope drop + dup chaos ---------------
+    m_c = Metrics()
+    plan = FaultPlan([
+        FaultSpec("transport.control_send", FaultKind.CONTROL_MSG_DROP,
+                  at=1),
+        FaultSpec("transport.control_send", FaultKind.CONTROL_MSG_DUP,
+                  at=2),
+    ])
+    _, seen_c, _ = drain(
+        os.path.join(base, "chaos.jrn"), m_c, plan=plan, kill_host=1,
+        n=14, pace_s=0.02,
+    )
+    if not plan.fired:
+        raise RuntimeError("envelope chaos specs never fired")
+    coverage_ok = sorted(seen_c) == list(range(n_shards)) and all(
+        np.array_equal(w, shard_pattern(s))
+        for s, wins in seen_c.items() for w in wins
+    )
+
+    # -- leg 4: scheduler fairness across the handover -----------------
+    class _FakeClock:
+        def __init__(self, t=100.0):
+            self.t = t
+
+        def __call__(self):
+            return self.t
+
+    def sched(clock):
+        s = FairShareScheduler(
+            quantum_bytes=1 << 20, metrics=Metrics(), clock=clock,
+        )
+        s.register(TenantSpec("heavy", weight=2.0,
+                              byte_budget_per_s=float(4 << 20)))
+        s.register(TenantSpec("light", weight=1.0,
+                              byte_budget_per_s=float(1 << 20)))
+        return s
+
+    def script(s, clock, steps):
+        trace = []
+        for _ in range(steps):
+            clock.t += 0.25
+            for name in ("heavy", "light"):
+                try:
+                    s.admit(name, timeout_s=0.0)
+                except StallTimeoutError:
+                    trace.append((name, "throttled"))
+                    continue
+                s.note_served(name, 1 << 20)
+                trace.append((name, "granted"))
+        return trace
+
+    c1, c2 = _FakeClock(), _FakeClock()
+    uninterrupted, interrupted = sched(c1), sched(c2)
+    script(uninterrupted, c1, 4)
+    script(interrupted, c2, 4)
+    snap = interrupted.export_state(now=c2())
+    standby = FairShareScheduler(metrics=Metrics(), clock=c2)
+    standby.adopt_state(snap, now=c2())
+    roundtrip_exact = standby.export_state(now=c2()) == snap
+    tail_a = script(uninterrupted, c1, 6)
+    tail_b = script(standby, c2, 6)
+    fairness_preserved = bool(
+        tail_a == tail_b
+        and any(t == ("light", "throttled") for t in tail_b)
+    )
+
+    dedup_evidence = (
+        m_c.counter("ctrl.acked_dup") + m_c.counter("ctrl.stale_acks")
+    )
+    return {
+        "takeover_s": round(ha_b.last_takeover_s, 4),
+        "lease_s": lease_s,
+        "kill_after_epoch": kill_after,
+        "epochs": n_epochs,
+        "journal_term": replayed.term,
+        "journal_records": replayed.records,
+        "promotions": int(m_b.counter("cluster.promotions")),
+        "supervisor_crashes": int(
+            m_b.counter("cluster.supervisor_crashes")
+        ),
+        "watchdog_failures": int(m_b.counter("watchdog.failures")),
+        "byte_identical": byte_identical,
+        "windows": sum(len(v) for v in crcs_b.values()),
+        "chaos": {
+            "wire_drops": int(m_c.counter("ctrl.wire_drops")),
+            "wire_dups": int(m_c.counter("ctrl.wire_dups")),
+            "retries": int(m_c.counter("ctrl.retries")),
+            "acked": int(m_c.counter("ctrl.acked")),
+            "dedup_evidence": int(dedup_evidence),
+            "watchdog_failures": int(m_c.counter("watchdog.failures")),
+            "coverage_byte_identical": bool(coverage_ok),
+        },
+        "scheduler_roundtrip_bit_exact": bool(roundtrip_exact),
+        "fairness_preserved": fairness_preserved,
+    }
+
+
 def _run_wire_ab() -> dict:
     """Raw vs quantized vs compressed exchange wire over a throttled
     link (ISSUE 13, ROADMAP item 3).
@@ -3290,6 +3572,27 @@ def main() -> None:
             result["headline_config"] = "async"
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["preempt"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "failover":
+        # `make failover-bench`: control-plane survivability priced end
+        # to end (ISSUE 18) — mid-stream supervisor kill with the
+        # lease-expiry standby takeover wall time as the headline, the
+        # window stream byte-identical to the steady-state reference
+        # with zero watchdog failures, adoption sends absorbed under
+        # envelope drop/dup chaos (dedup counters in the block), and
+        # scheduler fairness carried bit-exact across the handover
+        # (bench_smoke enforces every deterministic field).
+        result["metric"] = "failover_takeover_s"
+        result["unit"] = "s"
+        try:
+            result["failover"] = _run_failover_ab()
+            result["value"] = result["failover"]["takeover_s"]
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["failover"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

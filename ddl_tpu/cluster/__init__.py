@@ -40,6 +40,13 @@ from ddl_tpu.cluster.placement import (
     plan_placement,
 )
 from ddl_tpu.cluster.pool import LoaderPool
+from ddl_tpu.cluster.supervision import (
+    JournaledSupervisor,
+    ReplayedState,
+    SupervisorHA,
+    SupervisorJournal,
+    replay_journal,
+)
 from ddl_tpu.cluster.topology import LinkCosts, probe_link_costs
 
 __all__ = [
@@ -47,11 +54,15 @@ __all__ = [
     "ClusterView",
     "ElasticCluster",
     "HostInfo",
+    "JournaledSupervisor",
     "LeaseTable",
     "LinkCosts",
     "LoaderPool",
     "Placement",
+    "ReplayedState",
     "SimulatedFabric",
+    "SupervisorHA",
+    "SupervisorJournal",
     "measure_assignment",
     "modeled_bytes_per_s",
     "naive_placement",
@@ -59,6 +70,7 @@ __all__ = [
     "placement_report",
     "plan_placement",
     "probe_link_costs",
+    "replay_journal",
     "view_change",
     "view_rejoin",
     "worker_alive_source",

@@ -147,6 +147,23 @@ class ElasticCluster:
         self.loader = loader
         loader.apply_pool(self._local_pool(self.supervisor.view))
 
+    def rebind_supervisor(self, supervisor: ClusterSupervisor) -> None:
+        """Re-point the ladder at a freshly promoted supervisor
+        (``cluster.supervision.SupervisorHA.promote``): the new leader
+        gets this ladder's listeners and liveness sources, and the
+        consumer immediately adopts the replayed view's pool slice (a
+        view change the dead leader half-delivered is re-applied here —
+        epoch fences make the re-application idempotent)."""
+        supervisor.local_host_ids = self.supervisor.local_host_ids
+        self.supervisor = supervisor
+        supervisor.add_listener(self._on_view_change)
+        supervisor.add_rank_listener(self._on_rank_respawned)
+        if self.workers is not None:
+            self._attach_worker_sources()
+        if self.loader is not None:
+            self.loader.apply_pool(self._local_pool(supervisor.view))
+        self.metrics.incr("cluster.supervisor_rebinds")
+
     # -- the rung-2 ladder -------------------------------------------------
 
     def _on_view_change(
@@ -213,11 +230,14 @@ class ElasticCluster:
                     suspend_exchange=suspend_exchange,
                 )
                 try:
-                    # Under the connection's rejoin lock: adoption sends
-                    # (this thread) must serialize against replay
-                    # requests (consumer thread) and elastic channel
-                    # swaps (send_control).
-                    conn.send_control(rank - 1, msg)
+                    # Rides the acked envelope seam (under the
+                    # connection's rejoin lock): a dropped or duplicated
+                    # wire attempt becomes a dedup'd backoff retry
+                    # instead of a silently stranded adoption, and the
+                    # supervisor's fencing term rides the envelope so a
+                    # zombie ex-leader's late adoption dies at the
+                    # producer.
+                    conn.send_control_acked(rank - 1, msg)
                     sent += 1
                 except (OSError, ValueError):
                     # A dying channel mid-change: the watchdog/next view
@@ -268,7 +288,11 @@ class ElasticCluster:
             suspend_exchange=None,
         )
         try:
-            conn.send_control(rank - 1, msg)
+            # The acked seam, like every adoption send: the respawn race
+            # this re-send papers over is exactly a lost delivery, so it
+            # gets the same dedup'd-retry contract instead of a second
+            # fire-and-forget hope.
+            conn.send_control_acked(rank - 1, msg)
             self.metrics.incr("cluster.shard_adoptions")
         except (OSError, ValueError):
             logger.warning(

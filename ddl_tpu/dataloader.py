@@ -44,7 +44,12 @@ from ddl_tpu.obs import spans as obs_spans
 from ddl_tpu.obs.recorder import flight_dump
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import NOTHING, ConsumerConnection
-from ddl_tpu.types import Marker, MetaData_Consumer_To_Producer, ObsReport
+from ddl_tpu.types import (
+    ControlAck,
+    Marker,
+    MetaData_Consumer_To_Producer,
+    ObsReport,
+)
 from ddl_tpu.utils import for_all_methods, with_logging
 
 logger = logging.getLogger("ddl_tpu")
@@ -116,6 +121,9 @@ class DistributedDataLoader:
         self.connection = connection
         self.output = output
         self.metrics = metrics or default_metrics()
+        # The acked control seam's delivery counters (ctrl.*) land in
+        # this loader's registry (ddl_tpu.transport.envelope).
+        connection.control_metrics = self.metrics
         self.timeout_s = timeout_s
         self._epoch = 0
         self._batches_in_window = 0
@@ -818,6 +826,11 @@ class DistributedDataLoader:
             waiter.wait(0.02)
 
     def _drain_obs_once(self) -> int:
+        # Retry due unacked control envelopes first (the acked seam,
+        # ddl_tpu.transport.envelope): this drain runs once per window
+        # boundary and from every teardown/straggler wait, so it is the
+        # consumer's natural delivery heartbeat.
+        self.connection.pump_control()
         applied = 0
         for target in range(self.n_producers):
             while True:
@@ -833,6 +846,11 @@ class DistributedDataLoader:
                         )
                     if self._obs_merger.apply(msg):
                         applied += 1
+                elif isinstance(msg, ControlAck):
+                    # Producer acked an enveloped command: clear the
+                    # sender's pending retry (dedup/fence verdicts land
+                    # as ctrl.* counters inside the sender).
+                    self.connection.note_ack(msg)
                 else:
                     logger.warning(
                         "consumer: ignoring unexpected producer "
@@ -1377,8 +1395,13 @@ class DistributedDataLoader:
                         # fresh channel) before reading it; requests are
                         # idempotent rewinds, and a respawned replacement
                         # polls its new channel like any incarnation.
+                        # Rides the acked seam (request_replay wraps in
+                        # an envelope), so a merely-DROPPED wire attempt
+                        # is retried by pump below long before this
+                        # coarse 2s incarnation-loss backstop fires.
                         self.connection.request_replay(target, seq)
                         last_request = now
+                    self.connection.pump_control(now)
                     try:
                         slot = ring.acquire_drain(
                             min(2.0, deadline - now)

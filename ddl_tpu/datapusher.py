@@ -41,6 +41,7 @@ from ddl_tpu.obs import spans as obs_spans
 from ddl_tpu.observability import Metrics, metrics as default_metrics
 from ddl_tpu.transport.connection import NOTHING, ProducerConnection
 from ddl_tpu.types import (
+    ControlEnvelope,
     MetaData_Consumer_To_Producer,
     MetaData_Producer_To_Consumer,
     ReplayRequest,
@@ -114,6 +115,12 @@ class DataPusher:
         self._iteration = 0
         # Last applied cluster view epoch (ShardAdoption fence).
         self._view_epoch = -1
+        # Acked control-envelope unwrap (ddl_tpu.transport.envelope):
+        # dedup by (incarnation, seq) + command fencing, with an ack
+        # back per envelope so the consumer's retry loop terminates.
+        from ddl_tpu.transport.envelope import EnvelopeReceiver
+
+        self._envelope_rx = EnvelopeReceiver(producer_idx=producer_idx)
         # Cross-process observability shipping (ddl_tpu.obs): PROCESS
         # workers periodically send cumulative Metrics snapshots (+
         # armed-span deltas) back over this control channel; THREAD
@@ -596,15 +603,36 @@ class DataPusher:
     def _poll_control(self) -> None:
         """Drain pending control messages (non-blocking, once per window).
 
-        The channel is idle after the handshake; two message classes can
-        arrive mid-run: :class:`ReplayRequest` (quarantined corrupt slot
-        — rewind and re-commit) and the consumer's ABORT broadcast
-        (treated as shutdown, like the ring flag it accompanies).
+        The channel is idle after the handshake; command messages
+        (:class:`ReplayRequest` — quarantined corrupt slot, rewind and
+        re-commit; :class:`ShardAdoption` — cluster re-partition) arrive
+        mid-run wrapped in :class:`ControlEnvelope` when the sender uses
+        the acked seam, bare when legacy/fire-and-forget, plus the
+        consumer's ABORT broadcast (treated as shutdown, like the ring
+        flag it accompanies).  Envelopes are unwrapped through the
+        dedup + fencing receiver and ALWAYS acked — a duplicate or a
+        zombie ex-leader's fenced-off command is dropped unapplied, but
+        the ack still terminates the sender's retry loop.
         """
         while True:
             msg = self.connection.channel.try_recv()
             if msg is NOTHING:
                 return
+            if isinstance(msg, ControlEnvelope):
+                payload, ack = self._envelope_rx.accept(msg)
+                if ack.dup:
+                    self.metrics.incr("producer.ctrl_dup_dropped")
+                if ack.fence_rejected:
+                    self.metrics.incr("producer.ctrl_fence_dropped")
+                try:
+                    self.connection.channel.send(ack)
+                except (OSError, ValueError):
+                    # Consumer side gone mid-teardown: the ack is
+                    # best-effort (its sender is dead anyway).
+                    pass
+                if payload is None:
+                    continue
+                msg = payload  # dispatch the inner command below
             if isinstance(msg, ReplayRequest):
                 self._handle_replay(msg.seq)
             elif isinstance(msg, ShardAdoption):

@@ -191,6 +191,21 @@ _EXPLICIT: List[Knob] = [
        "'<grace_s>') to trigger the graceful-drain ladder."),
     _K("DDL_TPU_PREEMPT_DEADLINE_S", "float", 30.0,
        "Default drain deadline after a preemption notice, seconds."),
+    # -- control-plane survivability (cluster.supervision) ---------------
+    _K("DDL_TPU_SUPERVISOR_LEASE_S", "float", 2.0,
+       "Supervisor leadership lease budget, seconds: a standby "
+       "promotes itself when the leader's lease goes unrenewed this "
+       "long (ddl_tpu.cluster.supervision)."),
+    _K("DDL_TPU_SUPERVISOR_STANDBYS", "int", 1,
+       "Hot-standby supervisor count the HA tier provisions alongside "
+       "the leader (ddl_tpu.cluster.supervision)."),
+    _K("DDL_TPU_CTRL_RETRIES", "int", 5,
+       "Acked control-envelope retry cap per send "
+       "(ddl_tpu.transport.envelope); past it the send surfaces its "
+       "last transport error."),
+    _K("DDL_TPU_CTRL_BACKOFF_S", "float", 0.02,
+       "Initial acked control-envelope retry backoff, seconds "
+       "(doubles per retry; ddl_tpu.transport.envelope)."),
     # -- chaos / observability ------------------------------------------
     _K("DDL_TPU_FAULT_PLAN", "str", None,
        "JSON-encoded FaultPlan armed at import (the spawn-boundary "

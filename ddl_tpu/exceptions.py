@@ -174,6 +174,47 @@ class WindowsRevoked(StallTimeoutError):
     """
 
 
+class SupervisorCrashed(DDLError):
+    """The control-plane supervisor process died mid-lease (the
+    ``SUPERVISOR_CRASH`` fault kind at ``cluster.supervise``, or a real
+    leader loop tearing down).
+
+    The HA tier (:mod:`ddl_tpu.cluster.supervision`) absorbs it: the
+    leader's lease stops renewing, a standby observes expiry, replays
+    the journal, and promotes itself under the next fencing term.  It
+    never escapes a :class:`~ddl_tpu.cluster.supervision.SupervisorHA`
+    step — an unsupervised (HA-less) deployment sees it as fatal, which
+    is exactly the gap the HA tier exists to close.
+    """
+
+
+class ControlSendDropped(TransportError):
+    """One control-channel send was lost on the wire (the
+    ``CONTROL_MSG_DROP`` fault kind at ``transport.control_send``, or a
+    real pipe hiccup an adapter reports this way).
+
+    The acked envelope seam (:mod:`ddl_tpu.transport.envelope`) absorbs
+    it: the send stays pending and is retried with exponential backoff
+    until acked or the retry cap trips.  Raw fire-and-forget
+    ``send_control`` callers see it as the message silently vanishing —
+    which is why ddl-lint DDL025 pushes control sends through the seam.
+    """
+
+
+class NetworkPartitioned(TransportError):
+    """The control network partitioned: this side can neither deliver
+    nor receive control traffic for the duration (the
+    ``NETWORK_PARTITION`` fault kind at ``transport.control_send`` /
+    ``cluster.supervise``, or a real fabric event).
+
+    During a partition the envelope seam keeps retrying under its cap;
+    the supervisor lease on the far side keeps aging.  A heal after
+    lease expiry produces the split-brain scenario the fencing term
+    exists for: the old leader's post-heal commands carry a stale fence
+    and are dropped at every applier (docs/ROBUSTNESS.md walkthrough).
+    """
+
+
 class CheckpointError(DDLError):
     """A checkpoint could not be durably written or flushed
     (``ddl_tpu.resilience``): the async writer's final forced flush

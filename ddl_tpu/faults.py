@@ -119,6 +119,25 @@ Injection points shipped today (site — fault kinds that act there):
                           verification: quarantine + fallback to the
                           previous verified generation is what the
                           injection exercises
+``cluster.supervise``     top of every ``SupervisorHA.step``
+                          (``producer_idx`` carries the stepping
+                          node's id): ``SUPERVISOR_CRASH`` raises the
+                          real ``SupervisorCrashed`` — the leader dies
+                          mid-lease, a standby observes expiry,
+                          replays the journal, and promotes itself
+                          under the next fencing term;
+                          ``NETWORK_PARTITION`` isolates the stepping
+                          node (its renews/observations are lost for
+                          ``count`` steps — the split-brain setup)
+``transport.control_send``  inside ``ControlSender.send``, once per
+                          wire attempt (``producer_idx`` carries the
+                          TARGET producer): ``CONTROL_MSG_DROP`` /
+                          ``NETWORK_PARTITION`` lose the attempt (the
+                          real transport types — the seam's backoff
+                          retry absorbs them under the cap);
+                          ``CONTROL_MSG_DUP`` sends the same envelope
+                          twice (the receiver's (incarnation, seq)
+                          dedup absorbs it)
 ========================  ====================================================
 """
 
@@ -169,6 +188,10 @@ class FaultKind(enum.Enum):
     DECODE_FAIL = "decode_fail"
     PREEMPT_NOTICE = "preempt_notice"
     CKPT_CORRUPTION = "ckpt_corruption"
+    SUPERVISOR_CRASH = "supervisor_crash"
+    CONTROL_MSG_DROP = "control_msg_drop"
+    CONTROL_MSG_DUP = "control_msg_dup"
+    NETWORK_PARTITION = "network_partition"
 
 
 @dataclasses.dataclass
@@ -258,7 +281,7 @@ class FaultPlan:
         producer_idx: Optional[int],
         view: Any,
         should_abort: Optional[Callable[[], bool]],
-    ) -> None:
+    ) -> List[str]:
         due: List[FaultSpec] = []
         with self._lock:
             for i, spec in enumerate(self.specs):
@@ -292,6 +315,9 @@ class FaultPlan:
                     )
         for spec in due:
             self._act(spec, view=view, should_abort=should_abort)
+        # Non-raising kinds (CONTROL_MSG_DUP) reach here: the caller
+        # learns what fired and acts itself (the sender duplicates).
+        return [spec.kind.value for spec in due]
 
     def _act(
         self,
@@ -382,6 +408,36 @@ class FaultPlan:
             raise PreemptionNotice(
                 f"preemption notice {where}", deadline_s=spec.param
             )
+        elif kind is FaultKind.SUPERVISOR_CRASH:
+            # The real type (the BACKEND_FETCH_FAIL pattern): the HA
+            # tier's step must absorb a dead leader exactly as it would
+            # a real crash — lease stops renewing, standby promotes
+            # under the next fencing term after expiry.
+            from ddl_tpu.exceptions import SupervisorCrashed
+
+            raise SupervisorCrashed(f"supervisor crash {where}")
+        elif kind is FaultKind.CONTROL_MSG_DROP:
+            # Real transport type: the acked envelope seam must absorb
+            # a lost send exactly as it would a live pipe hiccup —
+            # bounded backoff retry until acked.
+            from ddl_tpu.exceptions import ControlSendDropped
+
+            raise ControlSendDropped(f"control send dropped {where}")
+        elif kind is FaultKind.NETWORK_PARTITION:
+            # A partition is a drop with a duration: count>1 keeps the
+            # site firing, so every retry inside the window is lost too
+            # and the lease on the far side ages toward the split-brain
+            # scenario (at cluster.supervise it suppresses the leader's
+            # lease renewal instead — same type, site decides).
+            from ddl_tpu.exceptions import NetworkPartitioned
+
+            raise NetworkPartitioned(f"network partitioned {where}")
+        elif kind is FaultKind.CONTROL_MSG_DUP:
+            # No raise: ``fault_point`` returns the fired kinds, the
+            # sender sees this one and sends the SAME envelope twice —
+            # the receiver's (incarnation, seq) dedup is what the
+            # injection tests.
+            return
         elif kind is FaultKind.SHUFFLE_PEER_LOSS:
             raise DDLError(f"shuffle peer loss {where}")
         else:  # pragma: no cover - FaultKind is closed above
@@ -401,14 +457,16 @@ def fault_point(
     producer_idx: Optional[int] = None,
     view: Any = None,
     should_abort: Optional[Callable[[], bool]] = None,
-) -> None:
+) -> Optional[List[str]]:
     """One named injection point.  No-op (one attribute read) unless a
     plan is armed; with a plan, matching specs act — raising, sleeping,
-    or corrupting ``view`` in place."""
+    or corrupting ``view`` in place.  Returns the fired kind values (a
+    possibly-empty list) so non-raising kinds (``CONTROL_MSG_DUP``) can
+    be acted on by the caller; ``None`` when disarmed."""
     plan = _ARMED
     if plan is None:
-        return
-    plan.fire(site, producer_idx, view, should_abort)
+        return None
+    return plan.fire(site, producer_idx, view, should_abort)
 
 
 def arm(plan: Optional[FaultPlan], export: bool = False) -> Optional[FaultPlan]:

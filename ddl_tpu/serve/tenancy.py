@@ -344,6 +344,87 @@ class FairShareScheduler:
                     st.revoked = False
             self._cond.notify_all()
 
+    # -- failover state transfer (ddl_tpu.cluster.supervision) -------------
+
+    def export_state(self, now: Optional[float] = None) -> dict:
+        """Snapshot the full DRR ledger as a JSON-serializable dict —
+        the supervisor journal's scheduler record.
+
+        Clock handling: absolute token-bucket stamps are exported
+        together with the export-time ``now``; :meth:`adopt_state`
+        shifts them by its own clock delta, so a snapshot adopted with
+        the same ``now`` roundtrips BIT-EXACT (the property the
+        failover suite pins) and one adopted later ages the buckets by
+        exactly the elapsed gap.  Live thread state (``waiting`` — the
+        blocked callers themselves) is deliberately NOT exported: a
+        promoted standby has its own callers; the ledger (deficits,
+        buckets, round/slot counters, in-flight grants, revocation
+        flags) is what fairness continuity needs.
+        """
+        with self._cond:
+            if now is None:
+                now = self._clock()
+            return {
+                "version": 1,
+                "now": float(now),
+                "quantum_bytes": self.quantum_bytes,
+                "round": self._round,
+                "next_index": self._next_index,
+                "tenants": {
+                    name: {
+                        "spec": {
+                            "name": st.spec.name,
+                            "weight": st.spec.weight,
+                            "byte_budget_per_s": st.spec.byte_budget_per_s,
+                            "slot_budget": st.spec.slot_budget,
+                        },
+                        "index": st.index,
+                        "deficit": st.deficit,
+                        "tokens": st.tokens,
+                        "stamp": st.stamp,
+                        "served_in_round": st.served_in_round,
+                        "inflight": st.inflight,
+                        "revoked": st.revoked,
+                    }
+                    for name, st in self._tenants.items()
+                },
+            }
+
+    def adopt_state(self, state: dict, now: Optional[float] = None) -> None:
+        """Replace this scheduler's ledger with an exported snapshot
+        (the promoted standby's half of :meth:`export_state`).
+
+        The adopted scheduler grants the same next-admission order the
+        snapshot's owner would have: deficits, buckets (aged by the
+        export→adopt clock gap), per-round slot counters, and the DRR
+        round/registration cursors all carry over.
+        """
+        if state.get("version") != 1:
+            raise DDLError(
+                f"unknown scheduler snapshot version {state.get('version')!r}"
+            )
+        with self._cond:
+            if now is None:
+                now = self._clock()
+            shift = float(now) - float(state["now"])
+            self.quantum_bytes = float(state["quantum_bytes"])
+            self._round = int(state["round"])
+            self._next_index = int(state["next_index"])
+            adopted: Dict[str, _TenantState] = {}
+            for name, t in state["tenants"].items():
+                spec = TenantSpec(**t["spec"])
+                st = _TenantState(spec, int(t["index"]), float(now))
+                st.deficit = float(t["deficit"])
+                st.tokens = float(t["tokens"])
+                st.stamp = float(t["stamp"]) + shift
+                st.served_in_round = int(t["served_in_round"])
+                st.inflight = int(t["inflight"])
+                st.revoked = bool(t["revoked"])
+                adopted[name] = st
+            self._tenants = adopted
+            self.metrics.set_gauge("serve.tenants", len(self._tenants))
+            self._cond.notify_all()
+
     # -- internals (condition lock held) -----------------------------------
 
     def _state(self, name: str) -> _TenantState:

@@ -12,6 +12,7 @@ from ddl_tpu.transport.connection import (
     ProducerConnection,
     ThreadChannel,
 )
+from ddl_tpu.transport.envelope import ControlSender, EnvelopeReceiver
 from ddl_tpu.transport.ring import DEFAULT_TIMEOUT_S, ThreadRing, WindowRing
 from ddl_tpu.transport.shm_ring import (
     NativeShmRing,
@@ -25,7 +26,9 @@ from ddl_tpu.transport.shm_ring import (
 __all__ = [
     "ConsumerConnection",
     "ControlChannel",
+    "ControlSender",
     "DEFAULT_TIMEOUT_S",
+    "EnvelopeReceiver",
     "NativeShmRing",
     "PipeChannel",
     "ProducerConnection",

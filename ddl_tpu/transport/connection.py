@@ -195,6 +195,15 @@ class ConsumerConnection:
         self.rings: List[WindowRing] = []
         self.replies: List[MetaData_Producer_To_Consumer] = []
         self._sent_meta: Optional[MetaData_Consumer_To_Producer] = None
+        # Acked control-envelope seam (ddl_tpu.transport.envelope): one
+        # lazily-built sender per target; bounded by n_producers.
+        self._senders: dict = {}  # ddl-lint: disable=DDL013
+        #: Fencing term stamped on every acked send (the supervisor HA
+        #: tier raises it at promotion — ddl_tpu.cluster.supervision).
+        self._control_fence = 0
+        #: Metrics sink for the senders' delivery counters (ctrl.*);
+        #: attached by the loader/elastic layer when it has one.
+        self.control_metrics: Any = None
         # Serialises the elastic-rejoin channel swap (watchdog thread,
         # rejoin_producer) against the consumer thread's shutdown /
         # finalize over the same lists: without it a shutdown racing an
@@ -333,25 +342,88 @@ class ConsumerConnection:
                 return NOTHING
 
     def send_control(self, target: int, msg: Any) -> None:
-        """Send a control-plane message to producer ``target`` (0-based
-        ring index) under the rejoin lock — concurrent senders (the
-        consumer's replay requests, the cluster ladder's shard
-        adoptions on the watchdog thread) must serialize against each
-        other AND against an in-flight elastic channel swap, or two
-        writes interleave on one pipe / a send lands on a
-        closed-but-unswapped channel."""
+        """Send a RAW (fire-and-forget) control-plane message to
+        producer ``target`` (0-based ring index) under the rejoin lock —
+        concurrent senders must serialize against each other AND against
+        an in-flight elastic channel swap, or two writes interleave on
+        one pipe / a send lands on a closed-but-unswapped channel.
+
+        Command messages (adoption, replay) should ride
+        :meth:`send_control_acked` instead — raw sends have no delivery
+        model (ddl-lint DDL025 enforces this at the configured command
+        sites); this primitive remains for the abort broadcast and as
+        the seam's own wire layer.
+        """
         with self._lock:
             self.channels[target].send(msg)
+
+    # -- acked envelope seam (ddl_tpu.transport.envelope) ------------------
+
+    def control_sender(self, target: int) -> Any:
+        """The per-target acked sender, built on first use.  Its wire
+        closure reads ``self.channels[target]`` at send time, so elastic
+        channel swaps are transparent to pending retries."""
+        from ddl_tpu.transport.envelope import ControlSender
+
+        with self._lock:
+            s = self._senders.get(target)
+            if s is None:
+                s = ControlSender(
+                    lambda msg, t=target: self.send_control(t, msg),
+                    target=target,
+                    metrics=self.control_metrics,
+                )
+                s.fence = self._control_fence
+                self._senders[target] = s
+            return s
+
+    def send_control_acked(self, target: int, msg: Any) -> int:
+        """Send ``msg`` through the acked envelope seam: sequenced,
+        fenced, deduped at the receiver, retried with backoff until
+        acknowledged (at-least-once + dedup — the explicit contract
+        replacing raw ``send_control``'s implicit exactly-once hope).
+        Returns the assigned envelope seq."""
+        with self._lock:
+            if self._finalized:
+                return -1
+            return self.control_sender(target).send(msg)
+
+    def pump_control(self, now: Optional[float] = None) -> int:
+        """Retry every due unacked envelope across all targets (called
+        from the consumer's periodic drains).  Returns resend count."""
+        with self._lock:
+            if self._finalized:
+                return 0
+            return sum(s.pump(now) for s in self._senders.values())
+
+    def note_ack(self, ack: Any) -> bool:
+        """Route a :class:`~ddl_tpu.types.ControlAck` drained off a
+        producer channel back to its sender's pending table.
+
+        ``ack.producer_idx`` carries the producer's 1-based rank (the
+        repo-wide ring convention); senders are keyed by the 0-based
+        channel index every ``send_control`` target uses."""
+        with self._lock:
+            s = self._senders.get(ack.producer_idx - 1)
+            return s.ack(ack) if s is not None else False
+
+    def set_control_fence(self, fence: int) -> None:
+        """Stamp ``fence`` on every future acked send (supervisor
+        promotion raises the term; appliers drop older ones)."""
+        with self._lock:
+            self._control_fence = int(fence)
+            for s in self._senders.values():
+                s.fence = self._control_fence
 
     def request_replay(self, target: int, seq: int) -> None:
         """Ask producer ``target`` (0-based ring index) to rewind and
         re-commit its window stream from logical window ``seq``
         (quarantine-and-replay for corrupt slots — ``ddl_tpu.integrity``).
-        Under the rejoin lock so a concurrent elastic channel swap sees a
-        consistent channel list."""
+        Rides the acked envelope seam: a lost request is retried with
+        backoff instead of silently stranding the quarantine wait."""
         from ddl_tpu.types import ReplayRequest
 
-        self.send_control(target, ReplayRequest(seq=seq))
+        self.send_control_acked(target, ReplayRequest(seq=seq))
 
     def shutdown_operation(self) -> None:
         """Wake every producer with the shutdown flag.

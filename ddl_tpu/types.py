@@ -143,6 +143,50 @@ class ShardAdoption:
 
 
 @dataclasses.dataclass
+class ControlEnvelope:
+    """Consumer → producer: one sequenced, fenced, acknowledged control
+    command (:mod:`ddl_tpu.transport.envelope`).
+
+    Wraps a control payload (:class:`ReplayRequest` /
+    :class:`ShardAdoption`) in the at-least-once + dedup contract:
+    ``(incarnation, seq)`` uniquely identifies the send across sender
+    restarts, so the receiver can suppress duplicates (retry storms,
+    the ``CONTROL_MSG_DUP`` chaos kind) while still re-acking them —
+    the sender retries with exponential backoff until acked.  ``fence``
+    carries the supervisor's fencing term (:mod:`ddl_tpu.cluster.
+    supervision`): a receiver that has seen a newer term drops the
+    payload unapplied (a zombie ex-leader's stale command), but still
+    acks so the dead sender stops retrying.
+    """
+
+    seq: int
+    incarnation: int
+    fence: int
+    payload: Any
+
+
+@dataclasses.dataclass
+class ControlAck:
+    """Producer → consumer: acknowledgement of one
+    :class:`ControlEnvelope` (:mod:`ddl_tpu.transport.envelope`).
+
+    ``(incarnation, seq)`` echoes the envelope's dedup key so the
+    sender clears exactly that pending retry.  ``dup`` marks a
+    suppressed duplicate (applied once before; re-acked, not
+    re-applied); ``fence_rejected`` marks a payload dropped by the
+    fencing rule — both are terminal for the sender's retry loop.
+    ``producer_idx`` names the acking producer for the consumer's
+    muxed drain.
+    """
+
+    seq: int
+    incarnation: int
+    producer_idx: int = 0
+    dup: bool = False
+    fence_rejected: bool = False
+
+
+@dataclasses.dataclass
 class ObsReport:
     """Producer → consumer: one cross-process observability report
     (:mod:`ddl_tpu.obs` aggregation).
@@ -284,5 +328,5 @@ def normalize_splits(splits: Sequence[int] | int, n_values: int) -> tuple[int, .
 #: The consumer's ABORT broadcast is a ``str`` sentinel, not a class
 #: (``ddl_tpu.env.ABORT``) — it rides the same channel but is checked
 #: by the dispatchers' string arm, outside these tuples.
-CONSUMER_TO_PRODUCER_CONTROL = (ReplayRequest, ShardAdoption)
-PRODUCER_TO_CONSUMER_CONTROL = (ObsReport,)
+CONSUMER_TO_PRODUCER_CONTROL = (ReplayRequest, ShardAdoption, ControlEnvelope)
+PRODUCER_TO_CONSUMER_CONTROL = (ObsReport, ControlAck)

@@ -262,6 +262,18 @@ VIOLATIONS = {
                     obs_spans.record("s", 1, 2, 0.0)  # span per SAMPLE
                 return sample
     """,
+    "DDL025": """
+        class ElasticCluster:
+            def _send_adoptions(self, view, suspend_exchange):
+                for rank in view.loader_ranks():
+                    msg = ShardAdoption(
+                        ranges=view.ranges_of(1), view_epoch=view.epoch,
+                    )
+                    conn.send_control(rank - 1, msg)   # raw: lossy wire
+
+            def _on_rank_respawned(self, rank):
+                conn.channel.send(ReplayRequest(seq=0))  # raw, direct
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -620,6 +632,21 @@ CLEAN = {
 
             def add(self, x):
                 self._items.append(x)
+    """,
+    "DDL025": """
+        class ElasticCluster:
+            def _send_adoptions(self, view, suspend_exchange):
+                for rank in view.loader_ranks():
+                    msg = ShardAdoption(
+                        ranges=view.ranges_of(1), view_epoch=view.epoch,
+                    )
+                    conn.send_control_acked(rank - 1, msg)  # the seam
+
+            def _on_rank_respawned(self, rank):
+                conn.send_control_acked(rank - 1, ReplayRequest(seq=0))
+
+        def helper_outside_config(conn, rank):
+            conn.send_control(rank, ShardAdoption(ranges=(), view_epoch=0))
     """,
 }
 

@@ -5,6 +5,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     ckpt_path,
     cluster_loops,
     concurrency,
+    control_send,
     device_path,
     fused_step,
     ingest_path,

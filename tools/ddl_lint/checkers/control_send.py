@@ -1,0 +1,127 @@
+"""Control commands must ride the acked envelope seam (DDL025).
+
+A control-plane command (``ReplayRequest``, ``ShardAdoption`` — the
+``types.py`` consumer→producer control tuple) pushed with a raw
+``.send(...)`` / ``.send_control(...)`` is fire-and-forget: one lost
+pipe write silently strands an adoption (a survivor serves stale shard
+ranges), one duplicated write double-applies a replay.  PR 18 made the
+delivery contract explicit — at-least-once with dedup and fencing via
+:class:`ddl_tpu.transport.envelope.ControlSender` — and repo rule
+(docs/LINT.md DDL025) is that every configured command-originating
+function routes sends through that seam
+(``ConsumerConnection.send_control_acked``), never the raw wire.
+
+The raw wire primitives themselves (``send_control``'s body, the
+sender's ``_raw_send`` closure, ack replies) stay unconfigured — the
+check scopes to the functions named in ``[tool.ddl_lint]
+control_send_functions``, where a command *originates*.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Raw wire verbs that bypass the seam when fed a control command.
+_RAW_SENDS = {"send", "send_control"}
+
+#: types.py control-command constructors (the consumer→producer tuple,
+#: plus a hand-rolled envelope — wrapping without the sender's retry
+#: state is the same silent-loss bug one layer up).
+_CONTROL_MSGS = {"ReplayRequest", "ShardAdoption", "ControlEnvelope"}
+
+
+@register
+class ControlSendPath(Checker):
+    """DDL025: raw send of a control command inside a configured
+    command originator.
+
+    Functions named in ``[tool.ddl_lint] control_send_functions`` (bare
+    names or ``Class.method``) originate control-plane commands.
+    Inside one, ``*.send(msg)`` / ``*.send_control(target, msg)`` where
+    ``msg`` is (or was locally assigned from) a control-message
+    constructor is a finding — route it through
+    ``send_control_acked`` so the envelope seam owns delivery.
+
+    Escape hatch: ``# ddl-lint: disable=DDL025`` with a rationale.
+    """
+
+    code = "DDL025"
+    summary = "raw control-command send bypasses the acked envelope seam"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_send_fn(node):
+            self._check_sends(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_send_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "control_send_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check_sends(self, fn: ast.AST) -> None:
+        # Pass 1: locals assigned from a control-message constructor
+        # (``msg = ShardAdoption(...)``) — the common shape; rebinding
+        # to something else is not tracked (the checker never guesses).
+        tainted: set = set()
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign) and self._is_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+        # Pass 2: raw send verbs fed a constructor or a tainted local.
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if last_segment(node.func) not in _RAW_SENDS:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for a in args:
+                if self._is_ctor(a) or (
+                    isinstance(a, ast.Name) and a.id in tainted
+                ):
+                    self._finding(node, fn)
+                    break
+
+    def _own_nodes(self, fn: ast.AST):
+        """Walk ``fn``'s body without descending into nested defs (a
+        nested def is checked when IT is configured)."""
+        stack = [fn]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                stack.append(child)
+            yield node
+
+    @staticmethod
+    def _is_ctor(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and last_segment(node.func) in _CONTROL_MSGS
+        )
+
+    def _finding(self, node: ast.AST, fn: ast.AST) -> None:
+        self.report(
+            node,
+            "raw control-command send inside "
+            f"{fn.name}()"  # type: ignore[attr-defined]
+            "; one lost pipe write strands the command, one duplicate "
+            "double-applies it — route it through the acked envelope "
+            "seam (ConsumerConnection.send_control_acked) so delivery "
+            "is at-least-once, dedup'd, and fenced",
+        )

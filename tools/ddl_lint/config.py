@@ -49,6 +49,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL022",  # bare checkpoint write bypassing atomic temp+rename
     "DDL023",  # unbounded obs event buffer / span emission per sample
     "DDL024",  # bare threading.Lock()/RLock()/Condition() without identity
+    "DDL025",  # raw control-command send bypassing the acked envelope seam
 )
 
 
@@ -190,6 +191,19 @@ class LintConfig:
             "save_train_state",
             "_write_manifest",
             "AsyncCheckpointer._write_generation",
+        ]
+    )
+    #: Control-command originators (bare name or ``Class.method``):
+    #: inside them a raw ``.send``/``.send_control`` of a ``types.py``
+    #: control message (``ReplayRequest``/``ShardAdoption``/a
+    #: hand-rolled ``ControlEnvelope``) is DDL025 — commands must ride
+    #: the acked envelope seam (``send_control_acked``) so delivery is
+    #: at-least-once, dedup'd, and fenced against zombie leaders.
+    control_send_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "ElasticCluster._send_adoptions",
+            "ElasticCluster._on_rank_respawned",
+            "ConsumerConnection.request_replay",
         ]
     )
     #: Observability event-buffer classes (DDL023 half 1): every
@@ -404,6 +418,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.checkpoint_write_functions = str_list(
         "checkpoint_write_functions", cfg.checkpoint_write_functions
+    )
+    cfg.control_send_functions = str_list(
+        "control_send_functions", cfg.control_send_functions
     )
     cfg.obs_event_buffer_classes = str_list(
         "obs_event_buffer_classes", cfg.obs_event_buffer_classes
