@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test failover-test failover-bench
+.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test failover-test failover-bench fabric-test fabric-bench
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -150,6 +150,20 @@ failover-test:
 # scheduler-fairness continuity asserted in the artifact.
 failover-bench:
 	DDL_BENCH_MODE=failover JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Multi-job ingest fabric unit + property tests (tests/test_fabric.py:
+# supervisor-resident admission, journal-replay failover, per-job
+# isolation seams, chaos-matrix rows for the fabric fault kinds).
+fabric-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fabric.py -q
+
+# The fleet soak end to end: 50 Zipf-weighted jobs / 100 simulated host
+# bindings against ONE supervisor-resident scheduler over the acked
+# control plane — weighted-share deviation headline, scale-reaction and
+# preemption-drain SLOs, per-job cache accounting, and the supervisor-
+# kill leg's bit-identical admission order in the artifact.
+fabric-bench:
+	DDL_BENCH_MODE=fabric JAX_PLATFORMS=cpu $(PY) bench.py
 
 # Host-vs-device global-shuffle exchange A/B (ThreadExchangeShuffler
 # over the rendezvous boards vs the on-mesh DeviceExchangeShuffler;

@@ -104,6 +104,11 @@ KIND_REJOIN = "rejoin"
 KIND_EPOCH_RESTORE = "epoch_restore"
 KIND_SCHEDULER = "scheduler"
 KIND_PROMOTION = "promotion"
+# Ingest-fabric records (ddl_tpu.serve.fabric appends them; string
+# literals here, not imports — the serve layer depends on cluster, and
+# replay only collects, never interprets, the fabric's payloads).
+KIND_JOB_ADMISSION = "job_admission"
+KIND_JOB_REGISTRY = "job_registry"
 
 
 # -- view (de)serialization ------------------------------------------------
@@ -285,6 +290,11 @@ class ReplayedState:
     scheduler_state: Optional[dict]
     records: int
     epoch_restores: int
+    #: Ingest-fabric state (ddl_tpu.serve.fabric): the newest job-
+    #: registry snapshot and every applied admission decision, in
+    #: journal order — the successor authority's exactly-once seed.
+    job_registry: Optional[dict] = None
+    admissions: List[dict] = dataclasses.field(default_factory=list)
 
 
 def replay_journal(journal: "SupervisorJournal | str") -> ReplayedState:
@@ -302,6 +312,8 @@ def replay_journal(journal: "SupervisorJournal | str") -> ReplayedState:
     term = 0
     departed: Dict[int, HostInfo] = {}  # ddl-lint: disable=DDL013
     scheduler_state: Optional[dict] = None
+    job_registry: Optional[dict] = None
+    admissions: List[dict] = []
     epoch_restores = 0
     records = journal.records()
     for rec in records:
@@ -336,6 +348,10 @@ def replay_journal(journal: "SupervisorJournal | str") -> ReplayedState:
             epoch_restores += 1
         elif kind == KIND_SCHEDULER:
             scheduler_state = data["state"]
+        elif kind == KIND_JOB_REGISTRY:
+            job_registry = data["state"]
+        elif kind == KIND_JOB_ADMISSION:
+            admissions.append(data)
         elif kind == KIND_PROMOTION:
             term = max(term, int(data["term"]))
         # Unknown kinds are skipped, not fatal: an older standby must
@@ -347,6 +363,8 @@ def replay_journal(journal: "SupervisorJournal | str") -> ReplayedState:
         scheduler_state=scheduler_state,
         records=len(records),
         epoch_restores=epoch_restores,
+        job_registry=job_registry,
+        admissions=admissions,
     )
 
 
@@ -413,6 +431,15 @@ class JournaledSupervisor(ClusterSupervisor):
         state = scheduler.export_state()
         seq = self.journal.append(KIND_SCHEDULER, {"state": state})
         self.metrics.incr("cluster.scheduler_snapshots")
+        return seq
+
+    def journal_job_registry(self, registry: Any) -> int:
+        """Snapshot a :class:`~ddl_tpu.serve.jobs.JobRegistry` into the
+        journal (the scheduler-snapshot pattern) so a promoted standby
+        reconstructs the fabric's job table beside its ledger."""
+        state = registry.export_state()
+        seq = self.journal.append(KIND_JOB_REGISTRY, {"state": state})
+        self.metrics.incr("cluster.job_registry_snapshots")
         return seq
 
 

@@ -47,6 +47,11 @@ LOCK_ORDER: Tuple[str, ...] = (
     # control plane (outermost: they fan out into everything below)
     "cluster.supervisor",
     "cluster.membership",
+    # Fabric admission authority (serve.fabric): the apply path holds
+    # the fabric lock while consulting the job registry and driving the
+    # scheduler, never the reverse.
+    "serve.fabric",
+    "serve.fabric.jobs",
     "serve.tenancy.cond",
     "resilience.guard",
     # consumer-side orchestration

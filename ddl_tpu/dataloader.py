@@ -169,6 +169,14 @@ class DistributedDataLoader:
         # window acquisition passes the fair-share gate before touching
         # a ring, and charges its byte size after — see bind_admission.
         self._admission: Any = None
+        # Per-job integrity namespace (ddl_tpu.serve.jobs): producers
+        # stamp trailer seqs at seq_base + iteration and this consumer
+        # expects exactly that slice, so a window leaking across jobs
+        # fails seq verification.  Rides the producer function — the
+        # wire_dtype handshake pattern — so both sides always agree.
+        self._seq_base = int(
+            getattr(data_producer_function, "seq_base", 0) or 0
+        )
         # Cross-process observability (ddl_tpu.obs): PROCESS workers
         # ship ObsReports over the control channel; the merger fences
         # and folds them into this registry under producer.<idx>.*.
@@ -1166,9 +1174,14 @@ class DistributedDataLoader:
     def _expected_seq(self, target: int, ahead: int) -> int:
         """Logical window number of the slot ``acquire_drain_ahead(ahead)``
         returns on ``target``: released count plus lookahead, minus the
-        commits discarded by past quarantine replays."""
+        commits discarded by past quarantine replays — offset into this
+        job's integrity namespace (``seq_base``)."""
         ring = self.connection.rings[target]
-        return int(ring.stats()["released"]) + ahead - self._seq_skew[target]
+        return (
+            self._seq_base
+            + int(ring.stats()["released"]) + ahead
+            - self._seq_skew[target]
+        )
 
     def _verify_slot(
         self, target: int, slot: int, expect_seq: int

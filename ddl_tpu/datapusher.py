@@ -141,6 +141,14 @@ class DataPusher:
         # The user's producer function is callbacks[0], exactly as in the
         # reference (datapusher.py:64); further callbacks append after it.
         self.callbacks: List[Any] = [meta.data_producer_function]
+        # Per-job integrity namespace (ddl_tpu.serve.jobs): trailer
+        # seqs are stamped at seq_base + iteration.  Rides the producer
+        # function — the wire_dtype handshake pattern — so the base
+        # crosses the spawn boundary with the function itself and the
+        # consumer reads the identical attribute.
+        self.seq_base = int(
+            getattr(meta.data_producer_function, "seq_base", 0) or 0
+        )
 
         init_ret = execute_callbacks(
             self.callbacks,
@@ -528,7 +536,7 @@ class DataPusher:
             integrity.write_header(
                 view,
                 self.window_nbytes,
-                seq=self._iteration,
+                seq=self.seq_base + self._iteration,
                 producer_idx=self.producer_idx,
                 crc=integrity.window_crc(payload),
             )
@@ -563,7 +571,7 @@ class DataPusher:
         crc = integrity.wire_crc(view, enc, self._scale_nbytes)
         integrity.write_header(
             view, enc,
-            seq=self._iteration,
+            seq=self.seq_base + self._iteration,
             producer_idx=self.producer_idx,
             crc=crc,
             wire_code=wire.WIRE_CODES[self.wire_dtype],
@@ -709,6 +717,10 @@ class DataPusher:
                 "replayable)", self.producer_idx, seq,
             )
             return
+        # The request carries the NAMESPACED seq (the consumer speaks
+        # trailer seqs); the producer function's logical position is
+        # the local half.
+        seq = max(0, int(seq) - self.seq_base)
         logger.warning(
             "producer %d: replaying window stream from %d "
             "(corrupt-slot re-request; was at %d)",

@@ -206,6 +206,23 @@ _EXPLICIT: List[Knob] = [
     _K("DDL_TPU_CTRL_BACKOFF_S", "float", 0.02,
        "Initial acked control-envelope retry backoff, seconds "
        "(doubles per retry; ddl_tpu.transport.envelope)."),
+    _K("DDL_TPU_FABRIC_QUANTUM_BYTES", "int", 4194304,
+       "DRR quantum of the fabric's resident fair-share scheduler, "
+       "bytes of credit per job per replenish round "
+       "(ddl_tpu.serve.fabric)."),
+    _K("DDL_TPU_FABRIC_SNAPSHOT_EVERY", "int", 1,
+       "Applied admission decisions between full scheduler snapshots "
+       "in the supervisor journal (ddl_tpu.serve.fabric; 1 = every "
+       "decision, the bit-exact failover default; 0 disables periodic "
+       "snapshots)."),
+    _K("DDL_TPU_FABRIC_ADMIT_TIMEOUT_S", "float", 30.0,
+       "Default fabric admission deadline per window, seconds "
+       "(ddl_tpu.serve.fabric.FabricJob.admit when the caller passes "
+       "none)."),
+    _K("DDL_TPU_FABRIC_DRAIN_SLO_S", "float", 2.0,
+       "Preemption-drain SLO for fabric job revocation, seconds: how "
+       "long revoke waits for in-flight granted windows to finish "
+       "(ddl_tpu.serve.fabric)."),
     # -- chaos / observability ------------------------------------------
     _K("DDL_TPU_FAULT_PLAN", "str", None,
        "JSON-encoded FaultPlan armed at import (the spawn-boundary "

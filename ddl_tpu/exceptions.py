@@ -215,6 +215,34 @@ class NetworkPartitioned(TransportError):
     """
 
 
+class AdmissionDropped(TransportError):
+    """One fabric admission command was lost on the wire (the
+    ``JOB_ADMISSION_DROP`` fault kind at ``serve.fabric.admit``, or a
+    real transport hiccup on the admission control channel).
+
+    The fabric client's acked envelope seam
+    (:mod:`ddl_tpu.serve.fabric` over
+    :mod:`ddl_tpu.transport.envelope`) absorbs it: the command stays
+    pending, backoff retry re-wires it, and the fabric's journal-seeded
+    dedup guarantees the scheduler ledger is mutated exactly once no
+    matter how many deliveries the retries produce.
+    """
+
+
+class JobCrashed(DDLError):
+    """A training job died mid-grant: ``admit`` returned, the window is
+    in flight, and ``note_served`` will never arrive (the ``JOB_CRASH``
+    fault kind at ``serve.fabric.grant``, or a real trainer crash an
+    operator reports).
+
+    The fabric absorbs it via :meth:`~ddl_tpu.serve.fabric.IngestFabric.
+    job_crashed`: the crashed job's in-flight grants are released, its
+    registration (and byte budget) removed, and its neighbours' shares
+    untouched — the chaos matrix pins byte-correctness of the
+    survivors.
+    """
+
+
 class CheckpointError(DDLError):
     """A checkpoint could not be durably written or flushed
     (``ddl_tpu.resilience``): the async writer's final forced flush

@@ -138,6 +138,21 @@ Injection points shipped today (site — fault kinds that act there):
                           ``CONTROL_MSG_DUP`` sends the same envelope
                           twice (the receiver's (incarnation, seq)
                           dedup absorbs it)
+``serve.fabric.admit``    the fabric client's admission wire attempt
+                          (``producer_idx`` carries the JOB
+                          registration index): ``JOB_ADMISSION_DROP``
+                          raises the real ``AdmissionDropped`` — the
+                          admit command is lost, the client's acked
+                          envelope retry re-wires it, and the fabric's
+                          journal-seeded dedup keeps the scheduler
+                          ledger exactly-once
+``serve.fabric.grant``    between a granted admit and its
+                          ``note_served`` charge (``producer_idx``
+                          carries the JOB registration index):
+                          ``JOB_CRASH`` raises the real ``JobCrashed``
+                          mid-grant — the fabric revokes the crashed
+                          job's in-flight grants, releases its budget,
+                          and its neighbours stay byte-correct
 ========================  ====================================================
 """
 
@@ -192,6 +207,8 @@ class FaultKind(enum.Enum):
     CONTROL_MSG_DROP = "control_msg_drop"
     CONTROL_MSG_DUP = "control_msg_dup"
     NETWORK_PARTITION = "network_partition"
+    JOB_ADMISSION_DROP = "job_admission_drop"
+    JOB_CRASH = "job_crash"
 
 
 @dataclasses.dataclass
@@ -432,6 +449,23 @@ class FaultPlan:
             from ddl_tpu.exceptions import NetworkPartitioned
 
             raise NetworkPartitioned(f"network partitioned {where}")
+        elif kind is FaultKind.JOB_ADMISSION_DROP:
+            # The real transport type (the BACKEND_FETCH_FAIL pattern):
+            # the fabric client's acked envelope seam must absorb a
+            # lost admission command exactly as it would a live wire
+            # hiccup — backoff retry, journal-seeded dedup on the
+            # fabric side keeping the ledger exactly-once.
+            from ddl_tpu.exceptions import AdmissionDropped
+
+            raise AdmissionDropped(f"job admission dropped {where}")
+        elif kind is FaultKind.JOB_CRASH:
+            # The real type (the BACKEND_FETCH_FAIL pattern): the
+            # fabric's crash ladder — revoke the job's in-flight
+            # grants, release its budget, unregister — is what the
+            # injection tests; neighbours must stay byte-correct.
+            from ddl_tpu.exceptions import JobCrashed
+
+            raise JobCrashed(f"job crashed mid-grant {where}")
         elif kind is FaultKind.CONTROL_MSG_DUP:
             # No raise: ``fault_point`` returns the fired kinds, the
             # sender sees this one and sends the SAME envelope twice —

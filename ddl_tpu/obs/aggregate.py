@@ -129,3 +129,19 @@ class ReportMerger:
         self.applied_reports += 1
         self.metrics.incr("obs.reports_applied")
         return True
+
+
+def adopt_job(
+    metrics: Any,
+    job_id: str,
+    snapshot: Dict[str, Any],
+    hists: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Merge one JOB's registry snapshot under ``job.<id>.*`` — the
+    :class:`ReportMerger` ``producer.<idx>.*`` pattern one level up
+    (ddl_tpu.serve.fabric): each training job's consumer ships its
+    cumulative registry to the fabric tier, and fleet-wide dashboards
+    read every job's ``ingest``/``cache``/``consumer`` families side by
+    side without collisions.  REPLACE-based, like every adopt —
+    snapshots are cumulative, so re-merging is idempotent."""
+    metrics.adopt(f"job.{job_id}.", snapshot, hists or {})

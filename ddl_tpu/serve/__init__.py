@@ -16,9 +16,25 @@ shared, demand-scaled ingest fabric (docs/SERVING.md):
   with hysteresis, cooldown, and a never-empty floor — re-running
   ``plan_placement`` on every resize (:class:`Autoscaler`,
   :class:`AutoscalerPolicy`).
+- **fabric** — the cross-host shape: ONE authoritative scheduler +
+  job registry resident beside the journaled supervisor, driven over
+  acked control envelopes, decisions journaled so admission order
+  survives supervisor failover bit-exact (:class:`IngestFabric`,
+  :class:`FabricClient`, :class:`FabricJob`); **jobs** — the job
+  model and per-job isolation seams: integrity namespaces, checkpoint
+  cursors, obs/cache accounting (:class:`JobSpec`,
+  :class:`JobRegistry`, :class:`JobCacheView`).
 """
 
 from ddl_tpu.serve.autoscaler import Autoscaler, AutoscalerPolicy
+from ddl_tpu.serve.fabric import FabricClient, FabricJob, IngestFabric
+from ddl_tpu.serve.jobs import (
+    JobCacheView,
+    JobRecord,
+    JobRegistry,
+    JobSpec,
+    integrity_namespace,
+)
 from ddl_tpu.serve.tenancy import (
     AdmissionController,
     FairShareScheduler,
@@ -30,7 +46,15 @@ __all__ = [
     "AdmissionController",
     "Autoscaler",
     "AutoscalerPolicy",
+    "FabricClient",
+    "FabricJob",
     "FairShareScheduler",
+    "IngestFabric",
+    "JobCacheView",
+    "JobRecord",
+    "JobRegistry",
+    "JobSpec",
     "Tenant",
     "TenantSpec",
+    "integrity_namespace",
 ]

@@ -248,3 +248,19 @@ class EnvelopeReceiver:
             seen.discard(min(seen))
         self.accepted += 1
         return env.payload, ack
+
+    def seed(self, incarnation: int, seq: int) -> None:
+        """Pre-mark ``(incarnation, seq)`` as already applied — the
+        journal-seeded dedup a rebuilt receiver runs after supervisor
+        failover (:meth:`ddl_tpu.serve.fabric.IngestFabric.
+        from_journal`): a retry of a command the DEAD leader applied
+        must dedup here, not re-mutate the successor's ledger."""
+        seen = self._seen.get(incarnation)
+        if seen is None:
+            seen = self._seen[incarnation] = set()
+            if len(self._seen) > 2:
+                for inc in sorted(self._seen)[:-2]:
+                    del self._seen[inc]
+        seen.add(int(seq))
+        if len(seen) > self.WINDOW:
+            seen.discard(min(seen))

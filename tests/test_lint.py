@@ -274,6 +274,19 @@ VIOLATIONS = {
             def _on_rank_respawned(self, rank):
                 conn.channel.send(ReplayRequest(seq=0))  # raw, direct
     """,
+    "DDL026": """
+        class Autoscaler:
+            def step(self):
+                # direct poke through an attribute chain: unjournaled
+                self.controller.scheduler.note_served("t0", 1 << 20)
+
+        def rebalance(snapshot):
+            s = FairShareScheduler(quantum_bytes=1 << 20)
+            s.adopt_state(snapshot)          # local ctor, tainted name
+            s.revoke_inflight(1.0)
+
+        FairShareScheduler().register(spec)  # module-level drive-by
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -647,6 +660,23 @@ CLEAN = {
 
         def helper_outside_config(conn, rank):
             conn.send_control(rank, ShardAdoption(ranges=(), view_epoch=0))
+    """,
+    "DDL026": """
+        class Tenant:
+            def note_served(self, nbytes):
+                # sanctioned: the tenancy facade IS the seam
+                self.controller.scheduler.note_served(self.name, nbytes)
+
+        class IngestFabric:
+            def _apply(self, payload):
+                self.scheduler.admit(payload.job_id, payload.timeout_s)
+
+        def read_only(sched):
+            state = sched.export_state()     # reads are unrestricted
+            return state["tenants"]
+
+        def other_registry(plugins, spec):
+            plugins.register(spec)           # not a scheduler receiver
     """,
 }
 

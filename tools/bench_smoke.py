@@ -332,6 +332,41 @@ REQUIRED_FAILOVER = (
 #: lock or a retry storm.
 MAX_TAKEOVER_S = 5.0
 
+#: The fabric block's contract (ISSUE 19: DDL_BENCH_MODE=fabric — one
+#: loader fleet serving 50 Zipf-weighted jobs from 100 simulated host
+#: bindings, every admission riding the acked control plane into the
+#: supervisor-resident scheduler).  Every field is load-bearing: the
+#: weighted-share deviation proves DRR fairness at fleet scale, the
+#: reaction/drain walls prove the scale and preemption SLOs, the cache
+#: block proves per-job accounting on the ONE shared store, and the
+#: failover block proves the admission order is bit-continuous across a
+#: supervisor kill with the retried grant served from the journal.
+REQUIRED_FABRIC = (
+    "jobs", "hosts", "steps", "window_bytes", "granted_windows",
+    "throttled_probes", "decisions", "share_deviation_max",
+    "share_deviation_mean", "scale_reaction_s", "drain", "cache",
+    "transport", "failover",
+)
+REQUIRED_FABRIC_FAILOVER = (
+    "admissions", "admission_order_identical",
+    "scheduler_ledger_identical", "dedup_replies", "successor_term",
+)
+#: Ceiling on the max per-job weighted-share deviation: the soak pins
+#: every job budget-bound (demand > byte budget, budget proportional to
+#: weight), so served bytes track weight up to window quantization —
+#: the lightest job sees ~20 windows over the soak, a ~5-7% floor, and
+#: 15% holds real margin without tolerating a broken DRR round.
+MAX_FABRIC_DEVIATION = 0.15
+#: Walls on the scale-reaction and preemption-drain legs: a late-joined
+#: job must reach 80% of its fair rate within 2 simulated seconds, and
+#: a revoke of the three heaviest jobs must drain their in-flight
+#: grants inside the same 2s SLO of wall time.
+MAX_FABRIC_REACTION_S = 2.0
+#: Floor on the shared-cache hit ratio under Zipf access: 8 jobs over
+#: 32 shards with zipf(1.5) concentrates mass on a handful of shards —
+#: measured ~0.9; 0.5 catches a cache that stopped sharing across jobs.
+MIN_FABRIC_HIT_RATIO = 0.5
+
 
 def _run_bench(mode: str) -> "dict | None":
     env = dict(os.environ)
@@ -1270,6 +1305,98 @@ def main() -> int:
             f"(roundtrip={fo['scheduler_roundtrip_bit_exact']}, "
             f"fairness={fo['fairness_preserved']}) — per-tenant "
             "admission order diverged post-failover"
+        )
+        return 1
+
+    # -- pass 2i: multi-job ingest fabric (ISSUE 19) -------------------
+    for attempt in range(1, 3):
+        fb_result = _run_bench("fabric")
+        if fb_result is None:
+            return 1
+        fb = fb_result.get("fabric")
+        if not isinstance(fb, dict):
+            print(json.dumps(fb_result, indent=1))
+            print(
+                "bench-smoke: no fabric block "
+                f"(errors={fb_result.get('errors')})"
+            )
+            return 1
+        fb_missing = [k for k in REQUIRED_FABRIC if k not in fb]
+        fb_missing += [
+            f"failover.{k}"
+            for k in REQUIRED_FABRIC_FAILOVER
+            if k not in fb.get("failover", {})
+        ]
+        if fb_missing:
+            print(json.dumps(fb, indent=1))
+            print(f"bench-smoke: fabric block missing keys: {fb_missing}")
+            return 1
+        # The noise-sensitive gates — retried once: the preemption drain
+        # is real wall time (a background finisher thread racing the
+        # revoke deadline), so it alone can suffer box noise.
+        drain = fb["drain"]
+        if drain["drained"] is True and drain["drain_s"] <= drain["slo_s"]:
+            break
+        if attempt < 2:
+            print(
+                f"bench-smoke: drain leg missed its SLO ({drain}); "
+                "retrying once (wall-clock leg, one-sided box noise)"
+            )
+            continue
+        print(json.dumps(fb, indent=1))
+        print(
+            f"bench-smoke: preemption drain failed ({drain}) — revoked "
+            "in-flight grants did not drain inside the SLO"
+        )
+        return 1
+    # Deterministic fabric gates — never retried: the soak runs on a
+    # simulated clock, so fairness, reaction time, cache accounting, and
+    # the failover ledger are all exactly reproducible.
+    if fb["share_deviation_max"] > MAX_FABRIC_DEVIATION:
+        print(json.dumps(fb, indent=1))
+        print(
+            f"bench-smoke: weighted-share deviation "
+            f"{fb['share_deviation_max']} > {MAX_FABRIC_DEVIATION} — "
+            "the fleet scheduler is not holding Zipf-weighted fairness"
+        )
+        return 1
+    if fb["scale_reaction_s"] > MAX_FABRIC_REACTION_S:
+        print(json.dumps(fb, indent=1))
+        print(
+            f"bench-smoke: late-joined job took {fb['scale_reaction_s']}s "
+            f"(> {MAX_FABRIC_REACTION_S}s simulated) to reach its fair "
+            "rate — admission is not reacting to registry changes"
+        )
+        return 1
+    if fb["drain"]["revoked_probe_typed"] is not True:
+        print(json.dumps(fb, indent=1))
+        print(
+            "bench-smoke: a revoked job's admit probe did not raise the "
+            "typed WindowsRevoked across the fabric seam"
+        )
+        return 1
+    fb_cache = fb["cache"]
+    if (
+        fb_cache["per_job_accounted"] is not True
+        or fb_cache["hit_ratio"] < MIN_FABRIC_HIT_RATIO
+    ):
+        print(json.dumps(fb, indent=1))
+        print(
+            f"bench-smoke: per-job cache accounting broke ({fb_cache}) — "
+            "job.<id>.cache.* must tile the shared store's counters"
+        )
+        return 1
+    fb_fo = fb["failover"]
+    if (
+        fb_fo["admission_order_identical"] is not True
+        or fb_fo["scheduler_ledger_identical"] is not True
+        or fb_fo["dedup_replies"] < 1
+        or fb_fo["admissions"] < 1
+    ):
+        print(json.dumps(fb, indent=1))
+        print(
+            "bench-smoke: admission order NOT bit-continuous across the "
+            f"supervisor kill ({fb_fo}) — journaled admission is broken"
         )
         return 1
 

@@ -7,6 +7,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     concurrency,
     control_send,
     device_path,
+    fabric_admission,
     fused_step,
     ingest_path,
     jax_hazards,

@@ -1,0 +1,154 @@
+"""Fair-share admission state is supervisor-resident (DDL026).
+
+PR 19 lifted admission authority out of the per-host
+``AdmissionController`` and into the supervisor tier: ONE
+:class:`~ddl_tpu.serve.tenancy.FairShareScheduler` lives beside the
+journaled supervisor, and every mutation reaches it through the acked
+control channel (``ddl_tpu.serve.fabric.IngestFabric``) so decisions
+are journaled, deduplicated, and fenced against zombie leaders.  A
+direct scheduler poke from anywhere else — ``sched.note_served(...)``
+on a locally constructed scheduler, ``something.scheduler.admit(...)``
+through an attribute — is unjournaled state divergence: after a
+supervisor failover the heir replays a ledger that never saw the
+mutation, and two hosts disagree about who was admitted.
+
+The sanctioned mutators (the tenancy facade's own methods, the fabric
+apply/crash/rebuild path, the HA promotion adopt) are configured in
+``[tool.ddl_lint] fabric_admission_functions``; everything else must
+route through a :class:`~ddl_tpu.serve.fabric.FabricClient` (cross-
+host) or a :class:`~ddl_tpu.serve.tenancy.Tenant` handle (in-process).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ddl_lint.checkers.base import Checker, register
+from tools.ddl_lint.context import last_segment
+
+#: Scheduler state mutators.  ``export_state``/``tenants``/``report``
+#: are reads and stay unrestricted; generic verbs (``register``,
+#: ``admit``) only count when the RECEIVER is recognizably the
+#: scheduler, so unrelated registries don't false-positive.
+_MUTATORS = {
+    "admit",
+    "note_served",
+    "note_aborted",
+    "revoke_inflight",
+    "clear_revocations",
+    "register",
+    "unregister",
+    "adopt_state",
+}
+
+#: Attribute names under which the shared scheduler is conventionally
+#: held (``self.scheduler``, ``fab._scheduler``).
+_SCHEDULER_ATTRS = {"scheduler", "_scheduler"}
+
+
+@register
+class FabricAdmissionPath(Checker):
+    """DDL026: direct FairShareScheduler mutation outside the
+    configured supervisor/fabric seam.
+
+    A mutator verb called on (a) a local assigned from
+    ``FairShareScheduler(...)``, (b) a name or attribute called
+    ``scheduler``/``_scheduler``, is a finding unless the enclosing
+    function (bare name or ``Class.method``) is listed in
+    ``[tool.ddl_lint] fabric_admission_functions``.
+
+    Escape hatch: ``# ddl-lint: disable=DDL026`` with a rationale.
+    """
+
+    code = "DDL026"
+    summary = (
+        "direct FairShareScheduler mutation bypasses the fabric seam"
+    )
+
+    def visit_Module(self, node: ast.Module) -> None:
+        # Module-level scripts poke schedulers too — no allowlist entry
+        # can sanction "<module>", by design.
+        self._check_mutations(node)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if not self._is_sanctioned(node):
+            self._check_mutations(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_sanctioned(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        allowed = getattr(self.config, "fabric_admission_functions", [])
+        return fn.name in allowed or qual in allowed  # type: ignore[attr-defined]
+
+    def _check_mutations(self, fn: ast.AST) -> None:
+        # Pass 1: locals assigned from the scheduler constructor
+        # (``s = FairShareScheduler(...)``); rebinding is not tracked.
+        tainted: set = set()
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign) and self._is_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+        # Pass 2: mutator verbs on a scheduler-shaped receiver.
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _MUTATORS:
+                continue
+            if self._is_scheduler(node.func.value, tainted):
+                self._finding(node, fn)
+
+    def _own_nodes(self, fn: ast.AST):
+        """Walk ``fn``'s body without descending into nested defs (a
+        nested def gets its own allowlist decision)."""
+        stack = [fn]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                stack.append(child)
+            yield node
+
+    @staticmethod
+    def _is_ctor(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and last_segment(node.func) == "FairShareScheduler"
+        )
+
+    def _is_scheduler(self, recv: ast.AST, tainted: set) -> bool:
+        if isinstance(recv, ast.Name):
+            return recv.id in tainted or recv.id in _SCHEDULER_ATTRS
+        if isinstance(recv, ast.Attribute):
+            return recv.attr in _SCHEDULER_ATTRS
+        if isinstance(recv, ast.Call):
+            # ``FairShareScheduler(...).register(...)`` — the
+            # fire-and-forget shape; still a direct poke.
+            return self._is_ctor(recv)
+        return False
+
+    def _finding(self, node: ast.AST, fn: ast.AST) -> None:
+        where = getattr(fn, "name", "<module>")
+        self.report(
+            node,
+            f"direct FairShareScheduler mutation inside {where}; "
+            "admission state is supervisor-resident and journaled — an "
+            "unjournaled poke diverges after failover (the heir replays "
+            "a ledger that never saw it).  Route it through a "
+            "FabricClient (cross-host) or Tenant handle (in-process), "
+            "or add the function to [tool.ddl_lint] "
+            "fabric_admission_functions if it IS the seam",
+        )

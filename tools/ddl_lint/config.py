@@ -50,6 +50,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL023",  # unbounded obs event buffer / span emission per sample
     "DDL024",  # bare threading.Lock()/RLock()/Condition() without identity
     "DDL025",  # raw control-command send bypassing the acked envelope seam
+    "DDL026",  # direct FairShareScheduler mutation outside the fabric seam
 )
 
 
@@ -204,6 +205,29 @@ class LintConfig:
             "ElasticCluster._send_adoptions",
             "ElasticCluster._on_rank_respawned",
             "ConsumerConnection.request_replay",
+        ]
+    )
+    #: Sanctioned FairShareScheduler mutators (bare name or
+    #: ``Class.method``): the tenancy facade, the fabric
+    #: apply/crash/rebuild path, and HA promotion adopt.  Everywhere
+    #: else a direct scheduler mutation is DDL026 — admission state is
+    #: supervisor-resident and journaled; unjournaled pokes diverge
+    #: across failover.
+    fabric_admission_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "Tenant.admit",
+            "Tenant.note_served",
+            "Tenant.note_aborted",
+            "Tenant.revoke_inflight",
+            "Tenant.clear_revocations",
+            "AdmissionController.register",
+            "AdmissionController._release",
+            "AdmissionController.revoke_inflight",
+            "AdmissionController.clear_revocations",
+            "IngestFabric._apply",
+            "IngestFabric._crash",
+            "IngestFabric.from_journal",
+            "SupervisorHA.promote",
         ]
     )
     #: Observability event-buffer classes (DDL023 half 1): every
@@ -421,6 +445,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.control_send_functions = str_list(
         "control_send_functions", cfg.control_send_functions
+    )
+    cfg.fabric_admission_functions = str_list(
+        "fabric_admission_functions", cfg.fabric_admission_functions
     )
     cfg.obs_event_buffer_classes = str_list(
         "obs_event_buffer_classes", cfg.obs_event_buffer_classes
