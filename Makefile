@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test failover-test failover-bench fabric-test fabric-bench
+.PHONY: lint verify test test-fast bench-smoke cache-bench ici-bench ici-dryrun opt-bench opt-dryrun opt-test placement-bench tenancy-bench serve-test multihost cluster-test check chaos wire-bench wire-dryrun wire-test preempt-test preempt-bench obs-bench obs-test shuffle-bench shuffle-dryrun shuffle-test failover-test failover-bench fabric-test fabric-bench tune-test tune-bench
 
 # Framework-invariant static analysis (tools/ddl_lint, docs/LINT.md).
 # Exit 0 = clean; findings print as file:line:col: DDL0xx message.
@@ -164,6 +164,19 @@ fabric-test:
 # kill leg's bit-identical admission order in the artifact.
 fabric-bench:
 	DDL_BENCH_MODE=fabric JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Self-tuning unit/e2e matrix (ddl_tpu/tune; docs/TUNING.md):
+# hysteresis, cooldown, never-worse revert, deadline-bounded
+# calibration, parity flip, drift replan, knob seams.
+tune-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tune.py -q
+
+# Self-tuned vs shipped-defaults from a mis-matched cold start (raw
+# wire on a throttled link, starved prefetch seed): Calibrator at boot
+# + KnobController live, interleaved A/B, never-slower gated by
+# bench_smoke.
+tune-bench:
+	DDL_BENCH_MODE=autotune JAX_PLATFORMS=cpu $(PY) bench.py
 
 # Host-vs-device global-shuffle exchange A/B (ThreadExchangeShuffler
 # over the rendezvous boards vs the on-mesh DeviceExchangeShuffler;

@@ -2654,6 +2654,310 @@ def _run_wire_ab() -> dict:
     return block
 
 
+class _AsyncLinkTransfer:
+    """A device-put stand-in that is genuinely IN FLIGHT: ``put``
+    returns immediately and a timer thread 'lands' the batch after
+    ``nbytes / link`` of simulated transfer time.  This is what gives
+    prefetch depth something to buy — with a synchronous put, depth
+    only changes queue length, never overlap."""
+
+    def __init__(self, batch, link_bytes_per_sec: float):
+        import threading
+
+        self.batch = batch
+        self._done = threading.Event()
+        delay = (
+            batch.nbytes / link_bytes_per_sec
+            if link_bytes_per_sec > 0 else 0.0
+        )
+        t = threading.Timer(delay, self._done.set)
+        t.daemon = True
+        t.start()
+
+    def wait(self):
+        self._done.wait()
+        return self.batch
+
+
+def _run_autotune() -> dict:
+    """Self-tuned vs shipped-defaults from a mis-matched cold start
+    (ISSUE 20, ROADMAP item 4: ddl_tpu.tune).
+
+    Both legs run the SAME two-phase workload on a deliberately
+    constrained simulated fabric: (A) ``rounds`` real
+    ``ThreadExchangeShuffler`` exchange rounds over a
+    :class:`_ThrottledRendezvous` link, then (B) ``batches`` prefetched
+    device transfers (:class:`_AsyncLinkTransfer` — put returns an
+    in-flight handle, so depth buys real overlap) each followed by a
+    fixed simulated compute step.
+
+    The SEED config is mis-matched to the fabric on purpose:
+    ``wire_dtype="raw"`` on a link slow enough that quantization wins
+    the break-even economics, and ``prefetch_depth=1`` (no overlap at
+    all).  The **defaults** leg runs the seed as shipped.  The
+    **tuned** leg starts cold from the same seed and pays for its own
+    tuning inside its timed window: a :class:`~ddl_tpu.tune.Calibrator`
+    pass (measured ``probe_link_costs`` over the throttled fabric +
+    the wire microbenchmark → int8 wire, depth floored to the shipped
+    default), then a :class:`~ddl_tpu.tune.KnobController` stepped
+    once per consumed batch, growing prefetch depth under hysteresis
+    with the never-worse guard live.  The tuned leg runs with the
+    flight recorder armed; the block counts its ``tune`` ring events.
+
+    Honesty gates baked into the block (bench_smoke enforces):
+    ``never_slower`` re-measured on a fresh confirmation pair (the
+    wire-bench pattern, never an argmax identity); ZERO never-worse
+    reverts in the winning leg; every decision carries ``cost_source``
+    provenance with at least one ``measured`` decision; the int8 leg's
+    loss curve passes ``loss_parity``; and the decisions were actually
+    flight-recorded.
+
+    Geometry knobs: ``DDL_BENCH_AUTOTUNE_ROWS``/``COLS`` (exchange
+    window AND batch shape, default 256x512),
+    ``DDL_BENCH_AUTOTUNE_ROUNDS`` (exchange rounds, default 6),
+    ``DDL_BENCH_AUTOTUNE_BATCHES`` (prefetch batches, default 24),
+    ``DDL_BENCH_AUTOTUNE_REPS`` (default 2),
+    ``DDL_BENCH_AUTOTUNE_LINK_MBPS`` (simulated link, default 16),
+    ``DDL_BENCH_AUTOTUNE_COMPUTE_MS`` (per-batch compute, default 6).
+    """
+    import threading
+
+    from ddl_tpu.config import LoaderConfig
+    from ddl_tpu.ingest import DeviceIngestor, PrefetchIterator
+    from ddl_tpu.obs import recorder as obs_recorder
+    from ddl_tpu.observability import Metrics
+    from ddl_tpu.parallel.optimizer import loss_parity
+    from ddl_tpu.shuffle import Rendezvous, ThreadExchangeShuffler
+    from ddl_tpu.tune import (
+        Calibrator,
+        ControllerPolicy,
+        KnobController,
+        prefetch_knob,
+    )
+    from ddl_tpu.types import Topology
+
+    rows = int(os.environ.get("DDL_BENCH_AUTOTUNE_ROWS", "256"))
+    cols = int(os.environ.get("DDL_BENCH_AUTOTUNE_COLS", "512"))
+    rounds = int(os.environ.get("DDL_BENCH_AUTOTUNE_ROUNDS", "6"))
+    batches = int(os.environ.get("DDL_BENCH_AUTOTUNE_BATCHES", "24"))
+    reps = int(os.environ.get("DDL_BENCH_AUTOTUNE_REPS", "2"))
+    link = (
+        float(os.environ.get("DDL_BENCH_AUTOTUNE_LINK_MBPS", "16"))
+        * (1 << 20)
+    )
+    compute_s = (
+        float(os.environ.get("DDL_BENCH_AUTOTUNE_COMPUTE_MS", "6")) / 1e3
+    )
+    num_exchange = rows
+    # Token-like compressible float windows (the wire bench's shape).
+    base = [
+        (np.random.default_rng(100 + i).integers(0, 32, (rows, cols)))
+        .astype(np.float32)
+        for i in range(2)
+    ]
+    seed_cfg = LoaderConfig(wire_dtype="raw", prefetch_depth=1)
+    total_samples = float(2 * rows * rounds + batches * rows)
+
+    def probe_losses(streams) -> list:
+        """Deterministic linear-probe SGD over the exchanged stream —
+        the loss-parity gate's curve (one per leg)."""
+        w = np.zeros(cols, np.float64)
+        y = np.sin(np.arange(rows)).astype(np.float64)
+        losses = []
+        for win in streams:
+            x = win.astype(np.float64)
+            pred = x @ w
+            losses.append(float(np.mean((pred - y) ** 2)))
+            grad = 2.0 * x.T @ (pred - y) / rows
+            w -= 1e-5 * grad
+        return losses
+
+    def run_exchange(wire_dtype, m):
+        """Phase A: both instances exchange over the throttled link;
+        returns instance 0's window stream."""
+        rdv = _ThrottledRendezvous(Rendezvous(), link)
+        streams = [[], []]
+        metrics = [m, Metrics()]
+        errors = []
+
+        def worker(i):
+            try:
+                topo = Topology(
+                    n_instances=2, instance_idx=i, n_producers=1
+                )
+                sh = ThreadExchangeShuffler(
+                    topo, 1, num_exchange=num_exchange, rendezvous=rdv,
+                    seed=7, wire_dtype=wire_dtype,
+                    exchange_timeout_s=60.0,
+                )
+                sh.metrics = metrics[i]
+                ary = base[i].copy()
+                for _ in range(rounds):
+                    sh.global_shuffle(ary)
+                    streams[i].append(ary.copy())
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        ts = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("autotune leg wedged (exchange stall)")
+        if errors:
+            raise errors[0]
+        return streams[0]
+
+    def run_prefetch(depth, m, with_controller):
+        """Phase B: consume `batches` in-flight transfers behind a
+        PrefetchIterator at `depth`; the tuned leg steps the controller
+        once per batch (the telemetry loop at batch cadence)."""
+        host = (
+            np.zeros((rows, cols), np.float32) for _ in range(batches)
+        )
+        it = PrefetchIterator(
+            host, DeviceIngestor(), depth=depth,
+            put=lambda b: _AsyncLinkTransfer(b, link),
+        )
+        ctrl = None
+        if with_controller:
+            # The shipped-second constants rescaled to the bench's
+            # batch cadence: each step's window is one full batch
+            # cycle, so a single above-band reading is already a
+            # sustained observation (sustain_s=0); the cooldown still
+            # spaces actions and runs the never-worse window.
+            ctrl = KnobController(
+                [prefetch_knob(it)],
+                policy=ControllerPolicy(
+                    up_stall_fraction=0.25, down_stall_fraction=0.0,
+                    sustain_s=0.0, cooldown_s=0.12,
+                ),
+                metrics=m,
+            )
+        for h in it:
+            with m.timed("consumer.wait"):
+                h.wait()
+            time.sleep(compute_s)
+            m.incr("consumer.samples", rows)
+            if ctrl is not None:
+                ctrl.step()
+        return it._depth, ctrl
+
+    def run_defaults():
+        """The seed as shipped: raw wire, depth 1, nobody watching."""
+        m = Metrics()
+        t0 = time.perf_counter()
+        stream = run_exchange(seed_cfg.wire_dtype, m)
+        run_prefetch(seed_cfg.prefetch_depth, m, False)
+        dt = time.perf_counter() - t0
+        return total_samples / dt, stream
+
+    def run_tuned():
+        """Cold start from the same seed; calibration + control INSIDE
+        the timed window (self-tuning must pay for itself)."""
+        m = Metrics()
+        rec = obs_recorder.FlightRecorder(capacity=8192)
+        with obs_recorder.armed(rec):
+            t0 = time.perf_counter()
+            cal = Calibrator(
+                deadline_s=2.0,
+                hosts=[0, 1],
+                transfer=lambda a, b, p: time.sleep(p.nbytes / link),
+                sample=base[0],
+                metrics=m,
+            )
+            tuned_cfg = cal.calibrate(seed_cfg)
+            cfg = tuned_cfg.apply(seed_cfg)
+            stream = run_exchange(cfg.wire_dtype, m)
+            final_depth, ctrl = run_prefetch(cfg.prefetch_depth, m, True)
+            dt = time.perf_counter() - t0
+        flight = sum(1 for e in rec.events() if e[1] == "tune")
+        return {
+            "rate": total_samples / dt,
+            "stream": stream,
+            "calibration": tuned_cfg,
+            "controller": ctrl,
+            "wire_dtype": cfg.wire_dtype,
+            "boot_depth": cfg.prefetch_depth,
+            "final_depth": final_depth,
+            "reverts": int(m.counter("tune.reverts")),
+            "cost_sources": {
+                src: int(m.counter(f"tune.cost_source.{src}"))
+                for src in ("measured", "declared", "default")
+            },
+            "flight_recorded": flight,
+        }
+
+    best_defaults = 0.0
+    best_tuned: dict = {}
+    defaults_stream: list = []
+    for _ in range(reps):  # interleaved: box noise hits both legs alike
+        d_rate, d_stream = run_defaults()
+        if d_rate > best_defaults:
+            best_defaults = d_rate
+        defaults_stream = d_stream
+        t = run_tuned()
+        if not best_tuned or t["rate"] > best_tuned["rate"]:
+            best_tuned = t
+
+    ctrl = best_tuned["controller"]
+    decisions = [
+        d.as_dict() for d in best_tuned["calibration"].decisions
+    ] + ([d.as_dict() for d in ctrl.decisions] if ctrl else [])
+    block: dict = {
+        "link_bytes_per_sec": link,
+        "rows": rows, "cols": cols, "rounds": rounds,
+        "batches": batches, "reps": reps,
+        "compute_ms": round(compute_s * 1e3, 2),
+        "seed": {
+            "wire_dtype": seed_cfg.wire_dtype,
+            "prefetch_depth": seed_cfg.prefetch_depth,
+        },
+        "legs": {
+            "defaults": {"samples_per_sec": round(best_defaults, 1)},
+            "tuned": {"samples_per_sec": round(best_tuned["rate"], 1)},
+        },
+        "tuned_knobs": {
+            "wire_dtype": best_tuned["wire_dtype"],
+            "boot_prefetch_depth": best_tuned["boot_depth"],
+            "final_prefetch_depth": best_tuned["final_depth"],
+        },
+        "calibration": best_tuned["calibration"].as_report(),
+        "controller": ctrl.report() if ctrl else {},
+        "decisions": decisions,
+        "cost_sources": best_tuned["cost_sources"],
+        "deadline_hit": best_tuned["calibration"].deadline_hit,
+        "reverts": best_tuned["reverts"],
+        "flight_recorded": best_tuned["flight_recorded"],
+        "vs_defaults": round(
+            best_tuned["rate"] / max(best_defaults, 1e-9), 3
+        ),
+    }
+    # Lossy-wire honesty: the tuned leg's exchanged stream must pass
+    # the loss-parity gate against the raw defaults stream.
+    parity = loss_parity(
+        probe_losses(defaults_stream),
+        probe_losses(best_tuned["stream"]),
+    )
+    block["parity"] = bool(parity["parity"])
+    block["parity_drift"] = parity["max_rel_drift"]
+    # Never-slower is a MEASUREMENT, not an argmax identity: a fresh
+    # confirmation pair, exactly the wire-bench discipline (bench_smoke
+    # asserts THIS flag, retried once against box noise).
+    c_rate, _ = run_defaults()
+    confirm_tuned = run_tuned()
+    block["confirm"] = {
+        "defaults": round(c_rate, 1),
+        "tuned": round(confirm_tuned["rate"], 1),
+    }
+    block["never_slower"] = bool(confirm_tuned["rate"] >= c_rate)
+    block["samples_per_sec"] = round(best_tuned["rate"], 1)
+    return block
+
+
 def _run_cache_ab() -> dict:
     """Cold-vs-warm epoch A/B for the shard cache over a throttled backend.
 
@@ -3909,6 +4213,28 @@ def main() -> None:
             result["headline_config"] = result["wire"]["winner"]
         except Exception as e:  # noqa: BLE001 - must emit JSON regardless
             errors["wire"] = f"{type(e).__name__}: {e}"
+            result["errors"] = errors
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(result))
+        return
+
+    if mode == "autotune":
+        # `make tune-bench`: self-tuned vs shipped-defaults from a
+        # deliberately mis-matched cold start (ISSUE 20) — boot
+        # calibration (measured link probe + wire break-even) plus the
+        # closed-loop knob controller, both paying for themselves
+        # inside the tuned leg's timed window.  Headline is the
+        # speedup ratio; bench_smoke gates never-slower (one noise
+        # retry), zero never-worse reverts in the winning leg, and
+        # measured cost_source provenance on the decisions.
+        result["metric"] = "autotune_vs_defaults"
+        result["unit"] = "x"
+        try:
+            result["autotune"] = _run_autotune()
+            result["value"] = result["autotune"]["vs_defaults"]
+            result["headline_config"] = "self-tuned"
+        except Exception as e:  # noqa: BLE001 - must emit JSON regardless
+            errors["autotune"] = f"{type(e).__name__}: {e}"
             result["errors"] = errors
         result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
         print(json.dumps(result))

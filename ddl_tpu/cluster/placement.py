@@ -141,6 +141,43 @@ def plan_placement(
     )
 
 
+def costs_drift(old: LinkCosts, new: LinkCosts) -> float:
+    """Max relative per-link bandwidth change between two cost tables.
+
+    The drift signal the steady-state tuner watches (ddl_tpu.tune): a
+    placement planned against ``old`` is stale when any link's measured
+    speed moved by more than the caller's tolerance.  Compared over the
+    union of hosts both tables know, so a link that appeared or vanished
+    registers as drift through the default-cost fallback rather than
+    being skipped.
+    """
+    hosts = sorted(set(old.hosts()) | set(new.hosts()))
+    drift = 0.0
+    for i, a in enumerate(hosts):
+        for b in hosts[i + 1:]:
+            o = old.bytes_per_s(a, b)
+            n = new.bytes_per_s(a, b)
+            drift = max(drift, abs(n - o) / max(o, 1e-9))
+    return drift
+
+
+def replan_on_drift(
+    view: ClusterView,
+    old_costs: LinkCosts,
+    new_costs: LinkCosts,
+    rel_tol: float = 0.25,
+) -> Optional[Placement]:
+    """Re-run :func:`plan_placement` iff measured costs drifted.
+
+    Returns the fresh :class:`Placement` when :func:`costs_drift`
+    exceeds ``rel_tol``, else ``None`` (the current placement stands) —
+    the hysteresis that keeps a noisy probe from thrashing assignments.
+    """
+    if costs_drift(old_costs, new_costs) <= rel_tol:
+        return None
+    return plan_placement(view, new_costs)
+
+
 class SimulatedFabric:
     """A measurable stand-in fabric: transfers really move the payload
     (memcpy) and really take ``nbytes / bytes_per_s(a, b)`` wall time

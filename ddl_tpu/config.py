@@ -88,6 +88,12 @@ class LoaderConfig:
     # spawn (ddl_tpu.env._export_shuffle_knobs).
     device_shuffle: str = "auto"
     shuffle_impl: str = "ring"
+    # Device transfers kept in flight by DistributedDataLoader.prefetch
+    # (ddl_tpu.ingest.PrefetchIterator).  A first-class config field —
+    # not a call-site literal — so the boot-time Calibrator and the
+    # steady-state KnobController (ddl_tpu.tune) have a seam to retune
+    # it through, with DDL_TPU_PREFETCH_DEPTH as the env mirror.
+    prefetch_depth: int = 2
 
     _ENV_PREFIX = "DDL_TPU_"
 

@@ -350,11 +350,13 @@ class DistributedDataLoader:
 
         return tuple(torch.from_numpy(c) for c in cols)
 
-    def prefetch(self, depth: int = 2):
+    def prefetch(self, depth: Optional[int] = None):
         """Iterate one epoch's device batches with ``depth`` transfers in
         flight (``output="jax"`` only) — while step k computes, batch k+1
         is already crossing into HBM (the standard TPU input recipe;
         VERDICT r2 item 5 wired this into the training path).
+        ``depth=None`` reads ``DDL_TPU_PREFETCH_DEPTH`` (the
+        config-mirrored seam the boot-time Calibrator retunes).
 
         Reads ahead *within the current window*: all ``len(self)`` batches
         of an epoch live in one window, and each batch is copied out of

@@ -380,6 +380,34 @@ def _export_shuffle_knobs(config: Any) -> None:
             _exported_shuffle_vars.discard(var)
 
 
+#: Tuning env vars THIS process exported from a config (never user-set
+#: ones) — the _export_wire_knobs precedent.
+_exported_tune_vars: set = set()
+
+
+def _export_tune_knobs(config: Any) -> None:
+    """Mirror a LoaderConfig's tunable-knob fields into the environment
+    (the ``_export_shuffle_knobs`` pattern) so the envspec seam every
+    tuned call site reads (``DDL_TPU_PREFETCH_DEPTH``) sees the config
+    — and so a ``TunedConfig`` overlay applied to the config before
+    loader construction reaches PROCESS-mode workers too.  Default-
+    valued fields state no opinion: they leave USER-set environment
+    untouched but clear this process's own prior exports.
+    """
+    if config is None:
+        return
+    for var, value, default in (
+        ("DDL_TPU_PREFETCH_DEPTH",
+         getattr(config, "prefetch_depth", 2), 2),
+    ):
+        if value is not None and int(value) != default:
+            os.environ[var] = str(value)
+            _exported_tune_vars.add(var)
+        elif var in _exported_tune_vars:
+            os.environ.pop(var, None)
+            _exported_tune_vars.discard(var)
+
+
 class WorkerSet:
     """The spawned producer workers + consumer-side connection."""
 
@@ -561,6 +589,7 @@ def distributed_dataloader(
             _export_cache_knobs(config)
             _export_wire_knobs(config)
             _export_shuffle_knobs(config)
+            _export_tune_knobs(config)
             workers = WorkerSet(topology, depth, shuffler_factory)
             env = DDL_Env(
                 topology=topology, connection=workers.connection,

@@ -223,6 +223,29 @@ _EXPLICIT: List[Knob] = [
        "Preemption-drain SLO for fabric job revocation, seconds: how "
        "long revoke waits for in-flight granted windows to finish "
        "(ddl_tpu.serve.fabric)."),
+    # -- self-tuning (ddl_tpu.tune) -------------------------------------
+    _K("DDL_TPU_TUNE_DEADLINE_S", "float", 2.0,
+       "Boot-time calibration budget, seconds (ddl_tpu.tune.Calibrator): "
+       "probes not finished by then fall back to declared/default costs "
+       "so calibration can never stall training start."),
+    _K("DDL_TPU_TUNE_INTERVAL_S", "float", 1.0,
+       "Steady-state KnobController poll cadence, seconds "
+       "(ddl_tpu.tune.controller; the DDL018 deadline-loop period)."),
+    _K("DDL_TPU_TUNE_SUSTAIN_S", "float", 2.0,
+       "How long a tuning signal must stay beyond its band before the "
+       "KnobController acts (hysteresis; the Autoscaler precedent)."),
+    _K("DDL_TPU_TUNE_COOLDOWN_S", "float", 5.0,
+       "Minimum spacing between KnobController knob changes, seconds "
+       "(also the post-change observation window the never-worse guard "
+       "judges before a revert)."),
+    _K("DDL_TPU_TUNE_REVERT_TOL", "float", 0.05,
+       "Never-worse guard tolerance: a knob change whose post-change "
+       "window throughput drops more than this fraction below the "
+       "pre-change window is reverted (ddl_tpu.tune.controller)."),
+    _K("DDL_TPU_TUNE_PARITY_HEADROOM", "float", 0.5,
+       "Lossy-wire safety margin: when max_rel_drift exceeds this "
+       "fraction of the loss_parity tolerance, the controller flips "
+       "the exchange wire back to raw (ddl_tpu.tune.controller)."),
     # -- chaos / observability ------------------------------------------
     _K("DDL_TPU_FAULT_PLAN", "str", None,
        "JSON-encoded FaultPlan armed at import (the spawn-boundary "
@@ -258,6 +281,8 @@ _CONFIG_FIELD_DOCS: Dict[str, str] = {
     "stall_budget_s": "Watchdog stall budget per producer.",
     "checkpoint_dir": "Loader checkpoint directory (unset = off).",
     "checkpoint_every_epochs": "Checkpoint cadence (0 = disabled).",
+    "prefetch_depth":
+        "Device transfers kept in flight by prefetch() (tunable).",
 }
 
 _TRAIN_FIELD_DOCS: Dict[str, str] = {

@@ -675,6 +675,18 @@ def north_star_report(
     report["obs_reports_applied"] = m.counter("obs.reports_applied")
     report["obs_reports_stale"] = m.counter("obs.reports_stale")
     report["obs_flight_dumps"] = m.counter("obs.flight_dumps")
+    # Self-tuning audit (ISSUE 20: ddl_tpu.tune).  How many knob
+    # decisions the Calibrator/KnobController made, how many the
+    # never-worse guard took back, and what evidence drove them — the
+    # cost_source histogram (measured / declared / default) that tells
+    # an operator whether this run was tuned from probes or from
+    # guesses.  Zeros in untuned runs by construction.
+    report["tune_decisions"] = m.counter("tune.decisions")
+    report["tune_reverts"] = m.counter("tune.reverts")
+    report["tune_cost_source"] = {
+        src: m.counter(f"tune.cost_source.{src}")
+        for src in ("measured", "declared", "default")
+    }
     if link_bytes_per_sec:
         report["link_bytes_per_sec"] = link_bytes_per_sec
         report["bandwidth_utilization"] = (
@@ -717,7 +729,7 @@ class PrefetchIterator:
         self,
         it: Any,
         ingestor: DeviceIngestor,
-        depth: int = 2,
+        depth: Optional[int] = None,
         put: Any = None,
         transfer: Any = None,
     ):
@@ -727,7 +739,8 @@ class PrefetchIterator:
         :data:`~ddl_tpu.staging.TransferFn`, e.g. from
         ``ingestor.batch_transfer_fn``) selects staged mode instead;
         staged direct-mode fills use ``put``, so pass both for the
-        adaptive fallback to stay on the pooled path."""
+        adaptive fallback to stay on the pooled path.  ``depth=None``
+        reads ``DDL_TPU_PREFETCH_DEPTH`` (the tunable seam)."""
         self._it = iter(it)
         self._ingestor = ingestor
         self._put = put or ingestor.put
@@ -735,8 +748,18 @@ class PrefetchIterator:
         # handoff costs without buying overlap (all-miss pool), so fills
         # go straight through `put` there.
         self._transfer = transfer if ingestor.batch_staged else None
+        if depth is None:
+            depth = envspec.get("DDL_TPU_PREFETCH_DEPTH")
         self._depth = max(1, depth)
         self._queue: collections.deque = collections.deque()
+
+    def set_depth(self, depth: int) -> None:
+        """Retune the in-flight transfer count live (ddl_tpu.tune).
+
+        Takes effect on the next ``__next__`` fill: a shrink simply
+        stops refilling until the queue drains below the new depth —
+        already-dispatched transfers are never cancelled."""
+        self._depth = max(1, int(depth))
 
     def __iter__(self) -> "PrefetchIterator":
         return self

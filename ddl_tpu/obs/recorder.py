@@ -235,6 +235,18 @@ def flight_dump(
     )
 
 
+def flight_note(kind: str, name: str, value: float) -> None:
+    """Append one event to the armed recorder (no-op when disarmed) —
+    the audit hook for NON-metric decisions that must appear in a
+    post-mortem ring: ddl_tpu.tune notes every knob change here as
+    ``("tune", knob, new_value)`` next to the signal values that
+    triggered it, so a dump shows WHAT the controller did interleaved
+    with WHY (the surrounding metric events)."""
+    rec = _ARMED
+    if rec is not None:
+        rec.note(kind, name, value)
+
+
 # Spawned processes arm themselves at import when the consumer exported
 # a flight request (the faults.PLAN_ENV pattern).
 _env_flight = envspec.raw(FLIGHT_ENV)

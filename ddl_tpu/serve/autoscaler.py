@@ -280,6 +280,19 @@ class Autoscaler:
         )
         return "down"
 
+    def retune(self, policy: AutoscalerPolicy) -> None:
+        """Swap the hysteresis policy live (the ddl_tpu.tune seam).
+
+        Sustain timers reset: a threshold that just moved must be held
+        beyond for a FULL sustain span before acting — carrying a timer
+        accumulated against the old band would let the first post-retune
+        tick fire on stale evidence.  The cooldown clock is kept: a
+        retune is not an action and must not unlock one early.
+        """
+        self.policy = policy
+        self._above_since = None
+        self._below_since = None
+
     def _replan(self, view) -> None:
         """Placement follows the pool: re-run the Cloud-Collectives
         reorder over the resized view whenever link costs are known."""

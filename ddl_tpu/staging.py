@@ -170,6 +170,19 @@ class StagingPool:
             if len(free) < self.max_per_key:
                 free.append(buf)
 
+    def set_max_per_key(self, max_per_key: int) -> None:
+        """Retune the per-geometry free-list cap live (ddl_tpu.tune).
+
+        Shrinking trims each free-list immediately — the controller's
+        revert must actually return memory, not wait for organic churn;
+        growing simply lets future releases keep more.
+        """
+        cap = max(1, int(max_per_key))
+        with self._lock:
+            self.max_per_key = cap
+            for free in self._free.values():
+                del free[cap:]
+
     def recycle_when_ready(self, buf: np.ndarray, dev: Any) -> None:
         """Queue ``buf`` for recycling once ``dev``'s transfer completes.
 
@@ -440,6 +453,21 @@ class TransferExecutor:
         #: (DDL_TPU_STAGING_QUEUE=1) would deadlock submit against a
         #: worker that never drains.
         self.worker_min_depth = min(2, self._max_queue)
+
+    def set_max_queue(self, max_queue: int) -> None:
+        """Retune the submission-queue bound live (ddl_tpu.tune).
+
+        Re-clamps ``worker_min_depth`` (the deadlock guard above must
+        track the new bound) and wakes every waiter: submitters blocked
+        against the old, smaller bound re-check and proceed immediately
+        when the queue grew.
+        """
+        with self._cv:
+            self._max_queue = max(1, int(max_queue))
+            self.worker_min_depth = min(
+                self.worker_min_depth, self._max_queue
+            )
+            self._cv.notify_all()
 
     def submit(
         self,

@@ -432,7 +432,7 @@ class Trainer:
                 )
             vals: List[Any] = []
             for _epoch in range(n_epochs):
-                it = loader.prefetch(2) if output == "jax" else loader
+                it = loader.prefetch() if output == "jax" else loader
                 for batch in it:
                     # Keep metrics as device arrays; a float() here would
                     # serialise loading against compute (see fit).
@@ -714,7 +714,7 @@ class Trainer:
         global_shuffle_fraction_exchange: Optional[float] = None,
         shuffler_factory: Any = None,
         loader_kwargs: Optional[dict] = None,
-        prefetch_depth: int = 2,
+        prefetch_depth: Optional[int] = None,
         window_stream: Optional[bool] = None,
         window_hook: Any = None,
         stream_lookahead: int = 1,
@@ -799,6 +799,15 @@ class Trainer:
             )
         nslots = 2 if nslots is None else nslots
         output = "jax" if output is None else output
+        if prefetch_depth is None:
+            # config field → env mirror → default, via the envspec seam
+            # (the tunable every ddl_tpu.tune knob change lands on).
+            if config is not None and hasattr(config, "prefetch_depth"):
+                prefetch_depth = config.prefetch_depth
+            else:
+                from ddl_tpu import envspec
+
+                prefetch_depth = envspec.get("DDL_TPU_PREFETCH_DEPTH")
         window_stream = bool(window_stream)
         if window_stream and output != "jax":
             raise ValueError("window_stream requires output='jax'")

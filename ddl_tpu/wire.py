@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 from typing import Any, Dict, Optional, Tuple
 
@@ -514,3 +515,144 @@ def wire_report(metrics: Any) -> Dict[str, float]:
         "fallbacks": metrics.counter("wire.fallbacks"),
         "decode_fails": metrics.counter("wire.decode_fails"),
     }
+
+
+# -- format economics ------------------------------------------------------
+#
+# The break-even model every wire decision in this repo prices against:
+# moving one raw byte over a link of speed L costs 1/L seconds on the
+# raw leg, and 1/enc + ratio/L + 1/dec on an encoded leg.  The encoded
+# leg wins exactly when L < (1 - ratio) / (1/enc + 1/dec) — a 4x ratio
+# is worthless behind a codec slower than the link.  One implementation,
+# shared by the probe_wire CLI and the boot-time Calibrator
+# (``ddl_tpu.tune``), so the operator-facing table and the controller's
+# decisions can never disagree.
+
+
+def measure_wire_stats(
+    sample: np.ndarray,
+    wire_dtypes: Tuple[str, ...] = ("bf16", "int8"),
+    codecs: Tuple[str, ...] = (),
+    level: int = 1,
+    deadline: Optional[float] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Microbenchmark each wire format on ``sample``, probe_wire-shaped.
+
+    Returns ``{fmt: {"ratio", "encode_bytes_per_s", "decode_bytes_per_s"}}``
+    (lossy entries add ``max_rel_drift``) — the stats dict
+    :func:`break_even_table` and :func:`pick_wire_format` consume.
+    ``deadline`` is an absolute ``time.monotonic()`` bound: formats not
+    reached before it are simply absent (the Calibrator's budget
+    discipline — a partial table beats a stalled training start).
+    """
+    sample = np.ascontiguousarray(sample)
+    out: Dict[str, Dict[str, float]] = {}
+    for wd in wire_dtypes:
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        if not lossy_supported(sample.dtype):
+            break
+        t0 = time.perf_counter()
+        payload, scales = encode_window(sample, wd)
+        t_enc = time.perf_counter() - t0
+        enc_bytes = payload.nbytes + (
+            scales.nbytes if scales is not None else 0
+        )
+        t0 = time.perf_counter()
+        dec = decode_window(
+            payload, scales, sample.shape, sample.dtype, wd
+        )
+        t_dec = time.perf_counter() - t0
+        drift = float(
+            np.abs(dec - sample).max()
+            / max(float(np.abs(sample).max()), 1e-9)
+        )
+        out[wd] = {
+            "ratio": round(enc_bytes / sample.nbytes, 4),
+            "encode_bytes_per_s": round(
+                sample.nbytes / max(t_enc, 1e-9), 1
+            ),
+            "decode_bytes_per_s": round(
+                sample.nbytes / max(t_dec, 1e-9), 1
+            ),
+            "max_rel_drift": drift,
+        }
+    raw = sample.tobytes()
+    for name in codecs:
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        if name not in available_codecs():
+            continue
+        c = get_codec(name)
+        t0 = time.perf_counter()
+        enc = c.encode_bytes(raw, level=level)
+        t_enc = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dec = c.decode_bytes(enc, max_output=2 * len(raw))
+        t_dec = time.perf_counter() - t0
+        if dec != raw:
+            continue  # a corrupting codec never enters the table
+        out[f"{name}-l{level}"] = {
+            "ratio": round(len(enc) / len(raw), 4),
+            "encode_bytes_per_s": round(len(raw) / max(t_enc, 1e-9), 1),
+            "decode_bytes_per_s": round(len(raw) / max(t_dec, 1e-9), 1),
+        }
+    return out
+
+
+def break_even_table(
+    stats: Dict[str, Any],
+    link_bytes_per_s: Optional[float] = None,
+) -> Dict[str, float]:
+    """Per-format break-even link speed (bytes/s) from measured stats.
+
+    ``stats`` maps format name → a dict carrying at least ``ratio``,
+    ``encode_bytes_per_s``, ``decode_bytes_per_s`` (non-dict or
+    ratio-free entries are skipped, so a probe_wire shard entry passes
+    through unfiltered).  A format appears only when it can win at all
+    (``ratio < 1.0``); its value is the link speed below which paying
+    the encode+decode CPU beats moving raw bytes.  When
+    ``link_bytes_per_s`` is given, formats whose threshold the measured
+    link already exceeds are dropped — what remains is exactly the set
+    worth flipping on for that link.
+    """
+    table: Dict[str, float] = {}
+    for fmt, st in stats.items():
+        if not isinstance(st, dict) or "ratio" not in st:
+            continue
+        enc = float(st.get("encode_bytes_per_s", 0.0))
+        dec = float(st.get("decode_bytes_per_s", 0.0))
+        if enc <= 0 or dec <= 0:
+            continue
+        denom = 1.0 / enc + 1.0 / dec
+        if st["ratio"] < 1.0 and denom > 0:
+            threshold = (1.0 - float(st["ratio"])) / denom
+            if link_bytes_per_s is None or link_bytes_per_s < threshold:
+                table[fmt] = threshold
+    return table
+
+
+def pick_wire_format(
+    stats: Dict[str, Any],
+    link_bytes_per_s: float,
+) -> str:
+    """The cheapest format for a link, ``"raw"`` included as the floor.
+
+    Prices one raw byte end to end (encode + wire + decode) per format
+    at the measured link speed and returns the argmin — the Calibrator's
+    wire_dtype decision, made from the same stats the break-even table
+    reports to operators.
+    """
+    link = max(float(link_bytes_per_s), 1e-9)
+    best, best_t = "raw", 1.0 / link
+    for fmt, st in sorted(stats.items()):
+        if not isinstance(st, dict) or "ratio" not in st:
+            continue
+        enc = float(st.get("encode_bytes_per_s", 0.0))
+        dec = float(st.get("decode_bytes_per_s", 0.0))
+        if enc <= 0 or dec <= 0:
+            continue
+        t = 1.0 / enc + float(st["ratio"]) / link + 1.0 / dec
+        if t < best_t:
+            best, best_t = fmt, t
+    return best

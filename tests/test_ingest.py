@@ -239,6 +239,9 @@ class TestNorthStarReport:
             "admission_wait_p99", "serve_tenant_admission_p99",
             "stage_breakdown", "obs_reports_applied",
             "obs_reports_stale", "obs_flight_dumps",
+            # self-tuning extras (ISSUE 20: ddl_tpu/tune —
+            # calibration/controller decision counts + provenance)
+            "tune_decisions", "tune_reverts", "tune_cost_source",
         }
         assert r["samples_per_sec"] > 0
         # The per-tenant stall block is a DICT keyed by tenant name

@@ -287,6 +287,19 @@ VIOLATIONS = {
 
         FairShareScheduler().register(spec)  # module-level drive-by
     """,
+    "DDL027": """
+        class DistributedDataLoader:
+            def prefetch(self, depth=2):     # literal default pins knob
+                it = PrefetchIterator(
+                    self.windows(), self._ingestor, depth=4,
+                )
+                return it
+
+        class Trainer:
+            def fit(self, loader, *, prefetch_depth=8):  # kwonly literal
+                pool = StagingPool(max_per_key=16)
+                return loader
+    """,
 }
 
 # A hazard snippet may legitimately imply a second code (none today, but
@@ -677,6 +690,25 @@ CLEAN = {
 
         def other_registry(plugins, spec):
             plugins.register(spec)           # not a scheduler receiver
+    """,
+    "DDL027": """
+        class DistributedDataLoader:
+            def prefetch(self, depth=None):  # None = read the registry
+                if depth is None:
+                    depth = envspec.get("DDL_TPU_PREFETCH_DEPTH")
+                return PrefetchIterator(
+                    self.windows(), self._ingestor, depth=depth,
+                )
+
+        class Trainer:
+            def fit(self, loader, *, prefetch_depth=None):
+                resolved = config.prefetch_depth
+                loader.prefetch(depth=resolved)
+                return loader
+
+        def unconfigured_helper():
+            # not in tuned_knob_functions: literals are fine here
+            return PrefetchIterator(iter([]), ing, depth=3)
     """,
 }
 

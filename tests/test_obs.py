@@ -655,7 +655,7 @@ class TestDocReflection:
                 r"<tenant>", r"\{[^}]+\}"
             ).replace(r"<idx>", r"\{[^}]+\}").replace(
                 r"<leg>", r"\{[^}]+\}"
-            )
+            ).replace(r"<src>", r"\{[^}]+\}")
             if not re.search(f"[\"']f?.*{pat}", blob) and not re.search(
                 pat, blob
             ):

@@ -366,6 +366,20 @@ MAX_FABRIC_REACTION_S = 2.0
 #: 32 shards with zipf(1.5) concentrates mass on a handful of shards —
 #: measured ~0.9; 0.5 catches a cache that stopped sharing across jobs.
 MIN_FABRIC_HIT_RATIO = 0.5
+#: The autotune block's contract (ISSUE 20: DDL_BENCH_MODE=autotune —
+#: self-tuned vs shipped-defaults from a mis-matched cold start).  The
+#: measured gates (vs_defaults >= 1, the fresh-pair never_slower flag)
+#: are wall-clock and retried once; everything else is deterministic:
+#: ZERO never-worse reverts in the winning leg, at least one MEASURED
+#: cost_source among the decisions (a tuned run that never consulted a
+#: probe is a guess with extra steps), every decision fully attributed,
+#: lossy-wire loss parity, and the decisions actually flight-recorded.
+REQUIRED_AUTOTUNE = (
+    "vs_defaults", "never_slower", "confirm", "legs", "seed",
+    "tuned_knobs", "calibration", "controller", "decisions",
+    "cost_sources", "reverts", "parity", "parity_drift",
+    "flight_recorded", "link_bytes_per_sec", "samples_per_sec",
+)
 
 
 def _run_bench(mode: str) -> "dict | None":
@@ -1400,6 +1414,88 @@ def main() -> int:
         )
         return 1
 
+    # -- pass 2j: self-tuning A/B (ISSUE 20) ---------------------------
+    for attempt in range(1, 3):
+        at_result = _run_bench("autotune")
+        if at_result is None:
+            return 1
+        at = at_result.get("autotune")
+        if not isinstance(at, dict):
+            print(json.dumps(at_result, indent=1))
+            print(
+                "bench-smoke: no autotune block "
+                f"(errors={at_result.get('errors')})"
+            )
+            return 1
+        at_missing = [k for k in REQUIRED_AUTOTUNE if k not in at]
+        if at_missing:
+            print(json.dumps(at, indent=1))
+            print(f"bench-smoke: autotune block missing keys: {at_missing}")
+            return 1
+        # The measured gates — retried once: both legs are wall-clock.
+        if at["vs_defaults"] >= 1.0 and at["never_slower"] is True:
+            break
+        if attempt < 2:
+            print(
+                "bench-smoke: autotune lost to shipped defaults "
+                f"(vs_defaults={at['vs_defaults']}, "
+                f"never_slower={at['never_slower']}, "
+                f"confirm={at['confirm']}); retrying once (wall-clock "
+                "legs, one-sided box noise)"
+            )
+            continue
+        print(json.dumps(at, indent=1))
+        print(
+            f"bench-smoke: self-tuned leg did not beat the shipped "
+            f"defaults (vs_defaults={at['vs_defaults']}, "
+            f"confirm={at['confirm']}) — the calibrator/controller is "
+            "mis-tuning a geometry it was built to win"
+        )
+        return 1
+    # Deterministic autotune gates — never retried.
+    if at["reverts"] != 0:
+        print(json.dumps(at, indent=1))
+        print(
+            f"bench-smoke: the winning tuned leg took {at['reverts']} "
+            "never-worse reverts — a headline built on reverted "
+            "changes is not a tuned configuration"
+        )
+        return 1
+    if at["cost_sources"].get("measured", 0) < 1:
+        print(json.dumps(at, indent=1))
+        print(
+            "bench-smoke: no decision carried measured cost_source "
+            f"({at['cost_sources']}) — the tuned leg never consulted "
+            "a probe"
+        )
+        return 1
+    if not at["decisions"] or any(
+        k not in d
+        for d in at["decisions"]
+        for k in ("knob", "old", "new", "cost_source", "reason")
+    ):
+        print(json.dumps(at, indent=1))
+        print(
+            "bench-smoke: autotune decisions missing or not fully "
+            "attributed (knob/old/new/cost_source/reason)"
+        )
+        return 1
+    if at["parity"] is not True:
+        print(json.dumps(at, indent=1))
+        print(
+            f"bench-smoke: tuned leg failed loss parity (drift "
+            f"{at['parity_drift']}) — the calibrated lossy wire is "
+            "not training-safe on this stream"
+        )
+        return 1
+    if at["flight_recorded"] < 1:
+        print(json.dumps(at, indent=1))
+        print(
+            "bench-smoke: tune decisions left no flight-recorder "
+            "events — the audit trail is broken"
+        )
+        return 1
+
     # -- pass 3: the fused training hot path (ISSUE 5 + 12) ------------
     for attempt in range(1, FIT_ATTEMPTS + 1):
         train = _run_bench("train")
@@ -1507,6 +1603,11 @@ def main() -> int:
         f"drain {pe['drain_s']}s, recovery {pe['recovery_wall_s']}s, "
         f"lost {pe['lost_steps']} <= {pe['lost_steps_bound']} steps, "
         "byte-identical resume; "
+        f"autotune vs_defaults {at['vs_defaults']} "
+        f"(knobs {at['tuned_knobs']}, {len(at['decisions'])} decisions, "
+        f"{at['reverts']} reverts, cost_sources {at['cost_sources']}, "
+        f"{at['flight_recorded']} flight-recorded, parity drift "
+        f"{at['parity_drift']:.1e}); "
         f"obs overhead {ob['overhead']} <= {MAX_OBS_OVERHEAD} "
         f"({ob['span_events']} spans, byte-identical, p50/p99 "
         f"{ob['window_latency_p50']}/{ob['window_latency_p99']}s, "

@@ -16,6 +16,7 @@ from tools.ddl_lint.checkers import (  # noqa: F401  (registration imports)
     producer_fill,
     protocol,
     serve_loops,
+    tune_path,
     wire_path,
 )
 from tools.ddl_lint.checkers.base import REGISTRY, Checker, register

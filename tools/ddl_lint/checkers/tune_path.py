@@ -1,0 +1,141 @@
+"""Tuned-knob seam: tuning constants route through TunedConfig/envspec.
+
+The self-tuning plane (``ddl_tpu/tune``) can only drive knobs whose
+call sites actually READ the seam: a ``prefetch(depth=2)`` hardcoded at
+a call site silently pins the knob no matter what the Calibrator
+measured or the KnobController decided — the loop keeps writing
+``DDL_TPU_PREFETCH_DEPTH`` and nothing moves, which is worse than no
+tuning because the audit trail claims a retune that never reached the
+data plane.  Repo rule (docs/LINT.md DDL027): inside a configured
+tuned-knob function, a tuning-knob argument is either ``None`` (= read
+the registry), a computed value, or a value explicitly routed through
+``envspec.get``/``TunedConfig`` — never a bare literal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ddl_lint.checkers.base import Checker, register
+
+#: Parameter names that are live tuning knobs: a LITERAL passed (or
+#: defaulted) for one of these inside a tuned-knob function bypasses
+#: the Calibrator/KnobController seam.
+_KNOB_PARAMS = {
+    "depth", "prefetch_depth", "max_queue", "max_per_key",
+    "wire_dtype",
+}
+
+
+def _walk_no_defs(root: ast.AST):
+    """Walk without descending into nested function/class defs."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def _is_literal(node: ast.AST) -> bool:
+    """A bare constant that is not the ``None`` read-the-registry
+    sentinel (negative literals parse as UnaryOp(USub, Constant))."""
+    if isinstance(node, ast.Constant):
+        return node.value is not None
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.operand, ast.Constant
+    ):
+        return True
+    return False
+
+
+@register
+class TunedKnobPath(Checker):
+    """DDL027: tuned-knob functions never hardcode tuning constants.
+
+    Functions named in ``[tool.ddl_lint] tuned_knob_functions`` (bare
+    names or ``Class.method``) sit on the path a tuned knob value takes
+    into the data plane.  Inside one:
+
+    - a knob-named parameter (``depth``/``prefetch_depth``/
+      ``max_queue``/``max_per_key``/``wire_dtype``) must not carry a
+      literal default — ``None`` (read the envspec registry) is the
+      seam; a literal pins the knob against every retune;
+    - a call passing a knob-named keyword must not pass a bare literal
+      — route it through ``envspec.get``, a config field the
+      ``TunedConfig`` overlay can replace, or a computed value.
+
+    Escape hatch: ``# ddl-lint: disable=DDL027`` with a rationale
+    (tests and benches constructing fixed geometries use it freely).
+    """
+
+    code = "DDL027"
+    summary = "hardcoded tuning constant bypassing the tune seam"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._is_tuned_fn(node):
+            self._check(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_tuned_fn(self, fn: ast.AST) -> bool:
+        qual = fn.name  # type: ignore[attr-defined]
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                qual = f"{anc.name}.{fn.name}"  # type: ignore[attr-defined]
+                break
+        hot = getattr(self.config, "tuned_knob_functions", [])
+        return fn.name in hot or qual in hot  # type: ignore[attr-defined]
+
+    def _check(self, fn: ast.FunctionDef) -> None:
+        # Signature defaults: `def prefetch(self, depth=2)` pins the
+        # knob for every caller that does not override it — the exact
+        # form the tune seam replaced with `depth=None`.
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+            if arg.arg in _KNOB_PARAMS and _is_literal(default):
+                self.report(
+                    default,
+                    f"literal default for tuning knob {arg.arg!r} in a "
+                    "tuned-knob function — it pins the knob against "
+                    "every Calibrator/KnobController decision; default "
+                    "to None and read the envspec registry (the "
+                    "TunedConfig seam)",
+                )
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if (
+                default is not None
+                and arg.arg in _KNOB_PARAMS
+                and _is_literal(default)
+            ):
+                self.report(
+                    default,
+                    f"literal default for tuning knob {arg.arg!r} in a "
+                    "tuned-knob function — it pins the knob against "
+                    "every Calibrator/KnobController decision; default "
+                    "to None and read the envspec registry (the "
+                    "TunedConfig seam)",
+                )
+        # Call keywords: `PrefetchIterator(it, ing, depth=4)` from a
+        # tuned-knob function bypasses whatever the tune plane decided.
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg in _KNOB_PARAMS and _is_literal(kw.value):
+                    self.report(
+                        kw.value,
+                        f"literal tuning constant {kw.arg}= passed from "
+                        "a tuned-knob function — the tune plane cannot "
+                        "reach a hardcoded call site; pass the config/"
+                        "envspec-resolved value (or None to read the "
+                        "registry) so TunedConfig overlays and live "
+                        "retunes take effect",
+                    )

@@ -51,6 +51,7 @@ ALL_CODES: Tuple[str, ...] = (
     "DDL024",  # bare threading.Lock()/RLock()/Condition() without identity
     "DDL025",  # raw control-command send bypassing the acked envelope seam
     "DDL026",  # direct FairShareScheduler mutation outside the fabric seam
+    "DDL027",  # hardcoded tuning constant bypassing the tune seam
 )
 
 
@@ -249,6 +250,21 @@ class LintConfig:
             "PackedTokenProducer._fill",
             "TFRecordTokenProducer._fill",
             "PrefetchIterator.__next__",
+        ]
+    )
+    #: Tuned-knob functions (bare name or ``Class.method``): the path a
+    #: tuning knob value takes into the data plane.  A literal
+    #: ``depth=``/``prefetch_depth=``/``max_queue=``/``max_per_key=``/
+    #: ``wire_dtype=`` default or call keyword inside one is DDL027 —
+    #: it pins the knob against every Calibrator/KnobController
+    #: decision (route through envspec/TunedConfig instead).
+    tuned_knob_functions: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "DistributedDataLoader.prefetch",
+            "PrefetchIterator.__init__",
+            "StagingPool.__init__",
+            "TransferExecutor.__init__",
+            "Trainer.fit",
         ]
     )
     #: Modules allowed to construct bare threading primitives — the
@@ -454,6 +470,9 @@ def load_config(pyproject: Optional[Path]) -> LintConfig:
     )
     cfg.per_sample_hot_functions = str_list(
         "per_sample_hot_functions", cfg.per_sample_hot_functions
+    )
+    cfg.tuned_knob_functions = str_list(
+        "tuned_knob_functions", cfg.tuned_knob_functions
     )
     cfg.lock_factory_modules = str_list(
         "lock_factory_modules", cfg.lock_factory_modules

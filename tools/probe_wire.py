@@ -98,20 +98,14 @@ def main():
             for wd in ("bf16", "int8"):
                 entry[wd] = _bench_lossy(arr, wd)
         out[name] = entry
-    # Break-even link: an encoded leg wins when
-    # raw/link > raw/enc_speed + ratio*raw/link + raw/dec_speed, i.e.
-    # link < (1-ratio) / (1/enc + 1/dec).  Report per format for the
-    # token-like float shard (the bench's geometry).
-    be = {}
-    for fmt, st in out["float_tokens"].items():
-        if not isinstance(st, dict) or "ratio" not in st:
-            continue
-        denom = (
-            1.0 / st["encode_bytes_per_s"] + 1.0 / st["decode_bytes_per_s"]
-        )
-        if st["ratio"] < 1.0 and denom > 0:
-            be[fmt] = round((1.0 - st["ratio"]) / denom / (1 << 20), 1)
-    out["break_even_link_mib_s"] = be
+    # Break-even link speeds per format for the token-like float shard
+    # (the bench's geometry).  One implementation, shared with the
+    # boot-time Calibrator: wire.break_even_table (bytes/s; the CLI
+    # reports MiB/s).
+    out["break_even_link_mib_s"] = {
+        fmt: round(v / (1 << 20), 1)
+        for fmt, v in wire.break_even_table(out["float_tokens"]).items()
+    }
 
     # Analytic ICI fan-out pricing on the virtual mesh (no kernels run).
     try:
